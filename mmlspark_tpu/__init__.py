@@ -1,0 +1,41 @@
+"""mmlspark_tpu — a TPU-native ML pipeline framework with the capabilities of
+MMLSpark (mhamilton723/mmlspark): Estimator/Transformer pipelines over columnar
+tables, deep-learning batch inference + transfer learning on JAX/pjit, fused
+Pallas image preprocessing, distributed GBDT and hashed online learners with
+XLA-collective AllReduce, low-latency serving, explainers, and analytics.
+"""
+from .version import __version__
+from .core.schema import Table, CategoricalMap, find_unused_column_name
+from .core.params import Param, ComplexParam, ServiceParam, Params, TypeConverters
+from .core.pipeline import (
+    PipelineStage,
+    Transformer,
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    LambdaTransformer,
+    ml_transform,
+)
+from .core import registry
+
+__all__ = [
+    "__version__",
+    "Table",
+    "CategoricalMap",
+    "find_unused_column_name",
+    "Param",
+    "ComplexParam",
+    "ServiceParam",
+    "Params",
+    "TypeConverters",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "LambdaTransformer",
+    "ml_transform",
+    "registry",
+]

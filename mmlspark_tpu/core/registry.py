@@ -1,0 +1,70 @@
+"""Stage registry: the reflection backbone.
+
+Reference: core/utils/JarLoadingUtils.scala:43 walks the classpath to find all
+`Wrappable` stages; codegen and the fuzzing harness (FuzzingTest.scala) build on
+it.  Here stages self-register via decorator; `all_stages()` drives the
+auto-fuzzing test harness and the bindings generator.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Dict, List, Optional, Type
+
+_REGISTRY: Dict[str, Type] = {}
+
+# modules whose import registers all public stages (kept in sync as the
+# framework grows; mirrored by mmlspark_tpu/__init__ lazy imports)
+STAGE_MODULES = [
+    "mmlspark_tpu.core.pipeline",
+    "mmlspark_tpu.stages",
+    "mmlspark_tpu.ops.image_stages",
+    "mmlspark_tpu.models.tpu_model",
+    "mmlspark_tpu.models.image_featurizer",
+    "mmlspark_tpu.featurize.featurize",
+    "mmlspark_tpu.featurize.value_indexer",
+    "mmlspark_tpu.featurize.clean_missing",
+    "mmlspark_tpu.featurize.text",
+    "mmlspark_tpu.models.train_classifier",
+    "mmlspark_tpu.models.statistics",
+    "mmlspark_tpu.gbdt.estimators",
+    "mmlspark_tpu.vw.estimators",
+    "mmlspark_tpu.vw.featurizer",
+    "mmlspark_tpu.automl.tuning",
+    "mmlspark_tpu.automl.find_best",
+    "mmlspark_tpu.explainers.stages",
+    "mmlspark_tpu.nn.knn",
+    "mmlspark_tpu.recommendation.sar",
+    "mmlspark_tpu.isolation_forest",
+    "mmlspark_tpu.io.http_stages",
+    "mmlspark_tpu.cognitive.services",
+]
+
+
+def register_stage(cls=None, *, name: Optional[str] = None):
+    def wrap(c):
+        _REGISTRY[name or c.__name__] = c
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def get_stage_class(name: str) -> Type:
+    if name not in _REGISTRY:
+        load_all_modules()
+    return _REGISTRY[name]
+
+
+def load_all_modules() -> List[str]:
+    loaded = []
+    for mod in STAGE_MODULES:
+        try:
+            importlib.import_module(mod)
+            loaded.append(mod)
+        except ModuleNotFoundError:
+            pass  # module not built yet — registry grows with the framework
+    return loaded
+
+
+def all_stages() -> Dict[str, Type]:
+    load_all_modules()
+    return dict(_REGISTRY)

@@ -1,0 +1,80 @@
+"""Usage telemetry: every stage verb logs a structured JSON record.
+
+Reference: core logging/BasicLogging.scala:25-71 — logClass/logFit/logTransform
+emit `{uid, className, method, buildVersion}`.  Here: a process-local ring
+buffer + stdlib logging, cheap enough to stay always-on, with wall-time capture
+(also covering stages/Timer.scala:55 TimerModel semantics).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import logging
+import time
+from typing import Any, Deque, Dict
+
+from .. import version
+
+logger = logging.getLogger("mmlspark_tpu.telemetry")
+
+_RECORDS: Deque[Dict[str, Any]] = collections.deque(maxlen=4096)
+
+
+def recent_records():
+    return list(_RECORDS)
+
+
+def clear_records():
+    _RECORDS.clear()
+
+
+@contextlib.contextmanager
+def log_verb(stage, method: str):
+    t0 = time.perf_counter()
+    err = None
+    try:
+        yield
+    except Exception as e:  # noqa: BLE001 — record then re-raise
+        err = type(e).__name__
+        raise
+    finally:
+        rec = {
+            "uid": getattr(stage, "uid", "?"),
+            "className": type(stage).__name__,
+            "method": method,
+            "buildVersion": version.__version__,
+            "wallTimeSec": round(time.perf_counter() - t0, 6),
+        }
+        if err:
+            rec["error"] = err
+        _RECORDS.append(rec)
+        logger.debug("%s", json.dumps(rec))
+
+
+class StopWatch:
+    """ns-resolution accumulating timer (core/utils/StopWatch.scala:6)."""
+
+    def __init__(self):
+        self.elapsed_ns = 0
+        self._start = None
+
+    def start(self):
+        self._start = time.perf_counter_ns()
+
+    def stop(self):
+        if self._start is not None:
+            self.elapsed_ns += time.perf_counter_ns() - self._start
+            self._start = None
+
+    @contextlib.contextmanager
+    def measure(self):
+        self.start()
+        try:
+            yield
+        finally:
+            self.stop()
+
+    @property
+    def elapsed_sec(self) -> float:
+        return self.elapsed_ns / 1e9

@@ -1,0 +1,26 @@
+from .basic import (
+    Cacher,
+    ClassBalancer,
+    ClassBalancerModel,
+    DropColumns,
+    EnsembleByKey,
+    Explode,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    Timer,
+    TimerModel,
+    UDFTransformer,
+)
+from .batching import (
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    HasMiniBatcher,
+    TimeIntervalMiniBatchTransformer,
+)
+from .text import TextPreprocessor, Trie, UnicodeNormalize

@@ -1,0 +1,3 @@
+from .fault_tolerance import retry_with_timeout, retry_with_backoff
+from .cluster import ClusterInfo, cluster_info
+from .async_utils import bounded_parallel_map

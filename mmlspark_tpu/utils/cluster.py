@@ -1,0 +1,62 @@
+"""Cluster/device topology: the ClusterUtil analog.
+
+Reference: core/utils/ClusterUtil.scala:20-175 infers #executors, tasks per
+executor, and the driver host from SparkConf/BlockManager to size LightGBM/VW
+communication rings.  On TPU the topology comes from jax: processes (hosts),
+local/global devices, and the coordinator address from jax.distributed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import List
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterInfo:
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    platform: str
+    host: str
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.process_count > 1
+
+    @property
+    def devices_per_process(self) -> int:
+        return self.global_device_count // max(self.process_count, 1)
+
+
+def cluster_info() -> ClusterInfo:
+    import jax
+
+    return ClusterInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+        platform=jax.default_backend(),
+        host=socket.gethostname(),
+    )
+
+
+def get_num_shards() -> int:
+    """Number of data shards to split work into (== global devices)."""
+    import jax
+
+    return jax.device_count()
+
+
+def find_open_port(start: int = 12400, tries: int = 200) -> int:
+    """Port scan from a base — reference lightgbm/TrainUtils.scala:193-220."""
+    for p in range(start, start + tries):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(("", p))
+                return p
+            except OSError:
+                continue
+    raise OSError(f"no open port in [{start}, {start + tries})")

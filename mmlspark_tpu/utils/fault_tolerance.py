@@ -1,0 +1,48 @@
+"""Generic retry with timeout / exponential backoff.
+
+Reference: core/utils/FaultToleranceUtils.scala:9 (retryWithTimeout) and the
+retry idioms in io/http/HTTPClients.scala:74-121 (429 Retry-After handling is
+in io/http_client.py which builds on this).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def retry_with_timeout(fn: Callable[[], T], timeout_sec: float, retries: int = 3) -> T:
+    """Run `fn` with a wall-clock timeout, retrying on failure/timeout."""
+    last: Optional[BaseException] = None
+    for _ in range(retries):
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(fn)
+            try:
+                return fut.result(timeout=timeout_sec)
+            except Exception as e:  # noqa: BLE001
+                last = e
+    raise last  # type: ignore[misc]
+
+
+def retry_with_backoff(
+    fn: Callable[[], T],
+    retries: int = 5,
+    initial_delay_sec: float = 0.1,
+    max_delay_sec: float = 30.0,
+    backoff: float = 2.0,
+    retryable: Tuple[Type[BaseException], ...] = (Exception,),
+) -> T:
+    delay = initial_delay_sec
+    last: Optional[BaseException] = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except retryable as e:
+            last = e
+            if attempt == retries - 1:
+                break
+            time.sleep(delay)
+            delay = min(delay * backoff, max_delay_sec)
+    raise last  # type: ignore[misc]

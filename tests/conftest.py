@@ -1,0 +1,40 @@
+"""Test config: force an 8-device virtual CPU mesh so sharding/collective
+paths run multi-device without TPU hardware (SURVEY.md §4 implication:
+multi-node-without-a-cluster testing, reference lightgbm/vw local[*] suites).
+Must run before jax import.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def small_table():
+    from mmlspark_tpu import Table
+
+    rng = np.random.default_rng(0)
+    return Table(
+        {
+            "features": rng.normal(size=(20, 4)).astype(np.float32),
+            "label": rng.integers(0, 2, size=20),
+            "text": [f"row {i}" for i in range(20)],
+            "value": rng.normal(size=20),
+        }
+    )

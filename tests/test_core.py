@@ -1,0 +1,183 @@
+"""Core runtime tests: Table, params, pipeline, serialization, telemetry."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import (
+    CategoricalMap,
+    LambdaTransformer,
+    Param,
+    Params,
+    Pipeline,
+    PipelineStage,
+    Table,
+    Transformer,
+    Estimator,
+    find_unused_column_name,
+    ml_transform,
+)
+from mmlspark_tpu.core.params import ComplexParam, ServiceParam, TypeConverters
+from mmlspark_tpu.core.telemetry import clear_records, recent_records
+
+from fuzzing import fuzz, roundtrip
+
+
+class TestTable:
+    def test_construct_and_access(self, small_table):
+        t = small_table
+        assert t.num_rows == 20
+        assert t["features"].shape == (20, 4)
+        assert t.column_names == ["features", "label", "text", "value"]
+
+    def test_ragged_object_column(self):
+        t = Table({"x": [[1, 2], [3], [4, 5, 6]]})
+        assert t["x"].dtype == object
+        assert list(t["x"][1]) == [3]
+
+    def test_with_column_select_drop_rename(self, small_table):
+        t = small_table.with_column("double", small_table["value"] * 2)
+        assert "double" in t
+        t2 = t.select(["double", "label"])
+        assert t2.column_names == ["double", "label"]
+        t3 = t.drop("text")
+        assert "text" not in t3
+        t4 = t.rename({"label": "y"})
+        assert "y" in t4 and "label" not in t4
+
+    def test_mismatched_length_raises(self):
+        with pytest.raises(ValueError):
+            Table({"a": [1, 2], "b": [1]})
+
+    def test_take_filter_slice_concat(self, small_table):
+        t = small_table
+        assert t.take([0, 1]).num_rows == 2
+        assert t.filter(t["label"] == 1).num_rows == int((t["label"] == 1).sum())
+        assert t.slice(5, 10).num_rows == 5
+        cat = Table.concat([t.slice(0, 5), t.slice(5, 20)])
+        assert cat.approx_equals(t)
+
+    def test_group_indices(self):
+        t = Table({"k": ["a", "b", "a", "a"]})
+        g = t.group_indices("k")
+        assert sorted(g) == ["a", "b"]
+        assert list(g["a"]) == [0, 2, 3]
+
+    def test_pandas_roundtrip(self, small_table):
+        df = small_table.to_pandas()
+        t2 = Table.from_pandas(df)
+        assert t2.num_rows == small_table.num_rows
+
+    def test_approx_equals(self, small_table):
+        assert small_table.approx_equals(small_table)
+        other = small_table.with_column("value", small_table["value"] + 1.0)
+        assert not small_table.approx_equals(other)
+
+    def test_meta_and_categorical(self):
+        cm = CategoricalMap(["x", "y", "z"])
+        t = Table({"c": [0, 1, 2]}, meta={"c": {"categorical": cm}})
+        assert t.get_meta("c")["categorical"].get_level(1) == "y"
+        assert cm.get_index("z") == 2
+
+    def test_find_unused_column_name(self):
+        assert find_unused_column_name("a", ["a", "a_1"]) == "a_2"
+        assert find_unused_column_name("b", ["a"]) == "b"
+
+
+def _drop_text(t):
+    return t.drop("text")
+
+
+class _ArrayHolder(Transformer):
+    arr = ComplexParam("array")
+
+    def _transform(self, t):
+        return t
+
+
+class _Scaler(Transformer):
+    input_col = Param("in col", default="value")
+    output_col = Param("out col", default="scaled")
+    factor = Param("scale factor", default=1.0, converter=TypeConverters.to_float)
+
+    def _transform(self, table):
+        return table.with_column(self.output_col, table[self.input_col] * self.factor)
+
+
+class _MeanEstimator(Estimator):
+    input_col = Param("in col", default="value")
+
+    def _fit(self, table):
+        m = float(np.mean(table[self.input_col]))
+        return _Scaler(factor=m).set(input_col=self.input_col)
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        s = _Scaler()
+        assert s.factor == 1.0
+        s.set(factor=2)
+        assert s.factor == 2.0  # converter applied
+        assert s.is_set("factor") and not s.is_set("input_col")
+
+    def test_unknown_param_raises(self):
+        with pytest.raises(KeyError):
+            _Scaler().set(nope=1)
+
+    def test_copy_with_extra(self):
+        s = _Scaler(factor=3.0)
+        c = s.copy({"factor": 4.0})
+        assert s.factor == 3.0 and c.factor == 4.0
+
+    def test_explain_params(self):
+        assert "factor" in _Scaler().explain_params()
+
+    def test_service_param(self):
+        class S(Params):
+            key = ServiceParam("api key", default=None)
+
+        s = S()
+        s.set(key="abc")
+        t = Table({"k": ["x", "y"]})
+        assert s.resolve("key", t) == "abc"
+        s.set_col("key", "k")
+        assert s.resolve("key", t, 1) == "y"
+
+
+class TestPipeline:
+    def test_fit_transform_chain(self, small_table):
+        pipe = Pipeline([_MeanEstimator(), LambdaTransformer(lambda t: t.drop("text"))])
+        model = pipe.fit(small_table)
+        out = model.transform(small_table)
+        assert "scaled" in out and "text" not in out
+
+    def test_ml_transform(self, small_table):
+        out = ml_transform(small_table, _Scaler(factor=2.0))
+        np.testing.assert_allclose(out["scaled"], small_table["value"] * 2)
+
+    def test_pipeline_roundtrip(self, small_table):
+        pipe = Pipeline([_MeanEstimator()])
+        fuzz(pipe, small_table)
+
+    def test_telemetry_records(self, small_table):
+        clear_records()
+        _Scaler().transform(small_table)
+        recs = recent_records()
+        assert recs and recs[-1]["className"] == "_Scaler"
+        assert recs[-1]["method"] == "transform"
+
+
+class TestSerialization:
+    def test_simple_roundtrip(self):
+        s = _Scaler(factor=5.0)
+        s2 = roundtrip(s)
+        assert s2.factor == 5.0 and s2.uid == s.uid
+
+    def test_complex_array_param(self):
+        a = _ArrayHolder()
+        a.set(arr=np.arange(6).reshape(2, 3))
+        a2 = roundtrip(a)
+        np.testing.assert_array_equal(a2.arr, a.arr)
+
+    def test_lambda_roundtrip(self, small_table):
+        lt = LambdaTransformer(_drop_text)
+        out = fuzz(lt, small_table)
+        assert "text" not in out

@@ -1,0 +1,204 @@
+"""Stage library tests: minibatching, batchers, plumbing transformers."""
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.core.batching import (
+    DynamicBufferedBatcher,
+    FixedBufferedBatcher,
+    fixed_batcher,
+    time_interval_batcher,
+)
+from mmlspark_tpu.stages import (
+    Cacher,
+    ClassBalancer,
+    DropColumns,
+    DynamicMiniBatchTransformer,
+    EnsembleByKey,
+    Explode,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    TimeIntervalMiniBatchTransformer,
+    Timer,
+    Trie,
+    UDFTransformer,
+    UnicodeNormalize,
+)
+
+from fuzzing import fuzz
+
+
+class TestBatchers:
+    def test_fixed_batcher(self):
+        assert list(fixed_batcher(range(7), 3)) == [[0, 1, 2], [3, 4, 5], [6]]
+
+    def test_fixed_buffered_batcher(self):
+        out = [b for b in FixedBufferedBatcher(range(10), 4)]
+        assert out == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+
+    def test_dynamic_buffered_batcher(self):
+        batches = list(DynamicBufferedBatcher(range(100)))
+        flat = [x for b in batches for x in b]
+        assert flat == list(range(100))
+        assert all(batches)
+
+    def test_buffered_batcher_propagates_errors(self):
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            list(FixedBufferedBatcher(gen(), 2))
+
+    def test_time_interval_batcher(self):
+        batches = list(time_interval_batcher(range(10), interval_ms=10000, max_batch=4))
+        assert [len(b) for b in batches] == [4, 4, 2]
+
+
+class TestMiniBatch:
+    def test_fixed_minibatch_and_flatten(self, small_table):
+        mb = FixedMiniBatchTransformer(batch_size=6)
+        batched = mb.transform(small_table)
+        assert batched.num_rows == 4  # ceil(20/6)
+        assert batched["features"][0].shape == (6, 4)
+        flat = FlattenBatch().transform(batched)
+        assert flat.num_rows == 20
+        np.testing.assert_allclose(
+            np.stack(list(flat["features"])), small_table["features"]
+        )
+
+    def test_buffered_minibatch(self, small_table):
+        mb = FixedMiniBatchTransformer(batch_size=8, buffered=True)
+        assert mb.transform(small_table).num_rows == 3
+
+    def test_dynamic_minibatch(self, small_table):
+        out = DynamicMiniBatchTransformer().transform(small_table)
+        assert out.num_rows == 1
+        assert out["features"][0].shape == (20, 4)
+
+    def test_time_interval_minibatch(self, small_table):
+        out = TimeIntervalMiniBatchTransformer(max_batch_size=7).transform(small_table)
+        assert out.num_rows == 3
+
+    def test_minibatch_fuzz(self, small_table):
+        fuzz(FixedMiniBatchTransformer(batch_size=5), small_table)
+
+
+class TestPlumbing:
+    def test_drop_select_rename(self, small_table):
+        assert "text" not in DropColumns(["text"]).transform(small_table)
+        assert SelectColumns(["label"]).transform(small_table).column_names == ["label"]
+        out = RenameColumn(input_col="label", output_col="y").transform(small_table)
+        assert "y" in out
+
+    def test_schema_validation(self, small_table):
+        with pytest.raises(ValueError):
+            DropColumns(["nope"]).transform_schema(small_table.column_names)
+        assert DropColumns(["text"]).transform_schema(small_table.column_names) == [
+            "features", "label", "value",
+        ]
+
+    def test_repartition_cacher(self, small_table):
+        out = Repartition(n=4).transform(small_table)
+        assert out.get_meta("__partitioning__")["num_partitions"] == 4
+        assert Cacher().transform(small_table).approx_equals(small_table)
+
+    def test_explode(self):
+        t = Table({"id": [1, 2], "xs": [[10, 20], [30]]})
+        out = Explode(input_col="xs").transform(t)
+        assert out.num_rows == 3
+        assert list(out["id"]) == [1, 1, 2]
+
+    def test_udf_transformer(self, small_table):
+        u = UDFTransformer(input_col="value", output_col="sq", udf=lambda v: v * v)
+        out = u.transform(small_table)
+        np.testing.assert_allclose(out["sq"], small_table["value"] ** 2)
+
+    def test_udf_multi_input(self, small_table):
+        u = UDFTransformer(
+            input_cols=["value", "label"], output_col="s", udf=lambda a, b: a + b
+        )
+        out = u.transform(small_table)
+        np.testing.assert_allclose(out["s"], small_table["value"] + small_table["label"])
+
+    def test_multi_column_adapter(self):
+        t = Table({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+        inner = UDFTransformer(udf=lambda v: v + 1)
+        mca = MultiColumnAdapter(
+            base_stage=inner, input_cols=["a", "b"], output_cols=["a2", "b2"]
+        )
+        out = mca.transform(t)
+        assert list(out["a2"]) == [2.0, 3.0] and list(out["b2"]) == [4.0, 5.0]
+
+    def test_ensemble_by_key(self):
+        t = Table({
+            "k": ["a", "a", "b"],
+            "v": np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]),
+        })
+        out = EnsembleByKey(keys=["k"], cols=["v"]).transform(t)
+        assert out.num_rows == 2
+        got = {k: list(v) for k, v in zip(out["k"], out["mean(v)"])}
+        assert got["a"] == [2.0, 3.0] and got["b"] == [5.0, 6.0]
+
+    def test_class_balancer(self, small_table):
+        model, out = fuzz(ClassBalancer(input_col="label"), small_table)
+        counts = {v: c for v, c in zip(*np.unique(small_table["label"], return_counts=True))}
+        maxc = max(counts.values())
+        for lbl, w in zip(small_table["label"], out["weight"]):
+            assert w == pytest.approx(maxc / counts[lbl])
+
+    def test_summarize_data(self, small_table):
+        out = SummarizeData().transform(small_table)
+        assert out.num_rows == 4
+        row = {n: out[n][out_idx] for out_idx in [list(out["Feature"]).index("value")] for n in out.column_names}
+        assert row["Count"] == 20.0
+        assert row["Min"] <= row["Median"] <= row["Max"]
+
+    def test_timer(self, small_table):
+        from mmlspark_tpu import LambdaTransformer
+
+        model = Timer(stage=LambdaTransformer(lambda t: t)).fit(small_table)
+        model.transform(small_table)
+        assert model.last_transform_time >= 0
+
+    def test_stratified_repartition(self):
+        t = Table({"label": [0] * 10 + [1] * 2})
+        out = StratifiedRepartition(n=2).transform(t)
+        parts = out["__partition__"]
+        labels = out["label"]
+        for p in (0, 1):
+            assert set(labels[parts == p]) == {0, 1}
+
+    def test_partition_consolidator(self, small_table):
+        out = PartitionConsolidator().transform(small_table)
+        assert out.approx_equals(small_table)
+
+
+class TestText:
+    def test_trie_longest_match(self):
+        trie = Trie({"cat": "feline", "ca": "X"})
+        assert trie.map_text("the cat sat") == "the feline sat"
+        assert trie.map_text("ca!") == "X!"
+
+    def test_text_preprocessor(self):
+        t = Table({"s": ["Hello World", "hello there"]})
+        tp = TextPreprocessor(
+            input_col="s", output_col="o", map={"hello": "hi"}, normalize_func="lower"
+        )
+        out = tp.transform(t)
+        assert list(out["o"]) == ["hi world", "hi there"]
+
+    def test_unicode_normalize(self):
+        t = Table({"s": ["Café"]})
+        out = UnicodeNormalize(input_col="s", output_col="o", form="NFKD").transform(t)
+        assert out["o"][0].startswith("cafe")
