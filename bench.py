@@ -1,0 +1,80 @@
+"""North-star benchmark: ResNet-50 ImageFeaturizer images/sec on one chip.
+
+BASELINE.json metric: "ImageFeaturizer images/sec/chip (ResNet-50)".  The
+reference publishes no absolute number (BASELINE.md); the recorded baseline is
+the same ResNet-50 forward on this container's host CPU via XLA-CPU, measured
+once with --measure-cpu and stored in BENCH_BASELINE.json.  vs_baseline is
+the TPU/CPU throughput ratio (higher is better).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+
+BATCH = 128
+WARMUP = 3
+ITERS = 10
+IMG = 224
+
+
+def _throughput(n_iters: int, batch: int) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mmlspark_tpu.models.bundle import FlaxBundle
+
+    bundle = FlaxBundle("resnet50", {"num_classes": 1000}, input_shape=(IMG, IMG, 3))
+    variables = jax.device_put(bundle.variables)
+
+    @jax.jit
+    def forward(v, batch_x):
+        return bundle.apply(v, batch_x)["pool"]
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, IMG, IMG, 3)).astype(np.float32))
+    forward(variables, x).block_until_ready()  # compile
+    for _ in range(WARMUP):
+        forward(variables, x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = forward(variables, x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return n_iters * batch / dt
+
+
+def main():
+    if "--measure-cpu" in sys.argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        ips = _throughput(2, 16)
+        with open(BASELINE_FILE, "w") as f:
+            json.dump({"cpu_images_per_sec": ips, "note":
+                       "ResNet-50 fwd bf16 on host XLA-CPU (1 core), batch 16"}, f)
+        print(json.dumps({"cpu_images_per_sec": ips}))
+        return
+
+    ips = _throughput(ITERS, BATCH)
+    baseline = None
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            baseline = json.load(f).get("cpu_images_per_sec")
+    vs = round(ips / baseline, 2) if baseline else 1.0
+    print(json.dumps({
+        "metric": "resnet50_imagefeaturizer_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

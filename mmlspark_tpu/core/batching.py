@@ -41,6 +41,14 @@ class _BufferedBatcherBase:
         self._q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
         self._err: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
+        self._consumed = False
+
+    def _mark_consumed(self):
+        if self._consumed:
+            raise RuntimeError(
+                f"{type(self).__name__} is single-use and already consumed"
+            )
+        self._consumed = True
 
     def _start(self, producer):
         def run():
@@ -55,6 +63,7 @@ class _BufferedBatcherBase:
         self._thread.start()
 
     def __iter__(self):
+        self._mark_consumed()
         while True:
             item = self._q.get()
             if item is self._SENTINEL:
@@ -99,6 +108,7 @@ class DynamicBufferedBatcher(_BufferedBatcherBase):
         self._start(produce)
 
     def __iter__(self):
+        self._mark_consumed()
         done = False
         while not done:
             batch: List[T] = []

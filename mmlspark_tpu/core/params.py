@@ -156,7 +156,12 @@ class Params:
     def set(self, **kwargs) -> "Params":
         for name, value in kwargs.items():
             p = self.param(name)
-            self._param_map[name] = p.convert(value) if value is not None else None
+            if value is None:
+                # pyspark semantics: setting None clears the explicit value,
+                # falling back to the declared default
+                self._param_map.pop(name, None)
+            else:
+                self._param_map[name] = p.convert(value)
         return self
 
     def set_col(self, name: str, col: str) -> "Params":

@@ -60,8 +60,12 @@ def load_all_modules() -> List[str]:
         try:
             importlib.import_module(mod)
             loaded.append(mod)
-        except ModuleNotFoundError:
-            pass  # module not built yet — registry grows with the framework
+        except ModuleNotFoundError as e:
+            # only suppress "this stage module isn't built yet"; a missing
+            # transitive dependency inside a present module must surface,
+            # or the registry silently shrinks
+            if e.name != mod and not mod.startswith(f"{e.name}."):
+                raise
     return loaded
 
 

@@ -1,0 +1,111 @@
+"""Image IO: decode bytes <-> image rows.
+
+Reference: core io/image/ImageUtils.scala:26-165 (decode bytes ->
+BufferedImage -> Spark image row and back; `safeRead` tolerant decode) and
+org/apache/spark/ml/source/image/PatchedImageFileFormat.scala.
+
+An "image row" is a dict with the Spark image schema fields
+(origin, height, width, nChannels, mode, data) where `data` is an
+HWC uint8 ndarray in **BGR** channel order (OpenCV/Spark convention).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import io as _io
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.schema import Table
+
+__all__ = [
+    "decode_image",
+    "encode_image_row",
+    "safe_read",
+    "image_row_to_array",
+    "array_to_image_row",
+    "read_image_dir",
+    "read_binary_files",
+]
+
+OCV_8UC1 = 0
+OCV_8UC3 = 16
+OCV_8UC4 = 24
+
+
+def array_to_image_row(arr: np.ndarray, origin: str = "") -> Dict[str, Any]:
+    arr = np.asarray(arr, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    h, w, c = arr.shape
+    mode = {1: OCV_8UC1, 3: OCV_8UC3, 4: OCV_8UC4}[c]
+    return {"origin": origin, "height": h, "width": w, "nChannels": c,
+            "mode": mode, "data": arr}
+
+
+def image_row_to_array(row: Dict[str, Any]) -> np.ndarray:
+    data = row["data"]
+    if isinstance(data, (bytes, bytearray)):
+        data = np.frombuffer(data, dtype=np.uint8)
+    arr = np.asarray(data, dtype=np.uint8)
+    return arr.reshape(row["height"], row["width"], row["nChannels"])
+
+
+def decode_image(data: bytes, origin: str = "") -> Dict[str, Any]:
+    """Decode compressed bytes (png/jpeg/bmp/...) to a BGR image row."""
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(data))
+    if img.mode not in ("RGB", "L", "RGBA"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 3 and arr.shape[2] >= 3:
+        arr = arr[:, :, :3][:, :, ::-1]  # RGB(A) -> BGR
+    return array_to_image_row(arr, origin)
+
+
+def safe_read(data: Optional[bytes], origin: str = "") -> Optional[Dict[str, Any]]:
+    """Tolerant decode: None on failure (ImageUtils.safeRead)."""
+    if data is None:
+        return None
+    try:
+        return decode_image(data, origin)
+    except Exception:  # noqa: BLE001 — by contract: any decode failure -> None
+        return None
+
+
+def encode_image_row(row: Dict[str, Any], fmt: str = "PNG") -> bytes:
+    from PIL import Image
+
+    arr = image_row_to_array(row)
+    if arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]  # BGR -> RGB
+    elif arr.shape[2] == 1:
+        arr = arr[:, :, 0]
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format=fmt)
+    return buf.getvalue()
+
+
+def read_binary_files(pattern: str, recursive: bool = True) -> Table:
+    """(path, bytes) table from a glob — BinaryFileFormat analog
+    (io/binary/BinaryFileFormat.scala:112, BinaryFileReader.scala:20)."""
+    paths = sorted(p for p in _glob.glob(pattern, recursive=recursive) if os.path.isfile(p))
+    values: List[bytes] = []
+    for p in paths:
+        with open(p, "rb") as f:
+            values.append(f.read())
+    return Table({"path": paths, "bytes": values})
+
+
+def read_image_dir(pattern: str, drop_invalid: bool = True) -> Table:
+    """Image-source analog (PatchedImageFileFormat.scala:154): decode every
+    file under the glob into an `image` column of image rows."""
+    files = read_binary_files(pattern)
+    rows = [safe_read(b, origin=p) for p, b in zip(files["path"], files["bytes"])]
+    t = Table({"image": rows})
+    if drop_invalid:
+        mask = np.array([r is not None for r in rows])
+        t = t.filter(mask)
+    return t

@@ -1,0 +1,139 @@
+"""ModelBundle: a serializable (architecture + weights) unit.
+
+Replaces the reference's `SerializableFunction` wrapper around CNTK.Function
+(com/microsoft/CNTK/SerializableFunction.scala:85-143): a model is
+(builder name + kwargs) — reconstructable code — plus a weights pytree,
+picklable because weights are stored as numpy.  Named outputs ("taps") give
+CNTK-style node addressing for feed/fetch dicts (CNTKModel.scala:229-371).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ModelBundle", "FlaxBundle", "FunctionBundle", "register_builder"]
+
+# name -> (module factory, layer names) — grows as model families are added
+_BUILDERS: Dict[str, Callable[..., Any]] = {}
+
+
+def register_builder(name: str, factory: Callable[..., Any]):
+    _BUILDERS[name] = factory
+    return factory
+
+
+def _to_numpy(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+class ModelBundle:
+    """Interface: named-output model with weights."""
+
+    input_shape: Optional[Tuple[int, ...]] = None  # per-example, e.g. (224,224,3)
+    layer_names: List[str] = []
+
+    def apply(self, variables, batch: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        raise NotImplementedError
+
+    @property
+    def variables(self):
+        raise NotImplementedError
+
+
+class FlaxBundle(ModelBundle):
+    """A registered flax module + its variables."""
+
+    def __init__(
+        self,
+        builder: str,
+        builder_kwargs: Optional[dict] = None,
+        variables: Any = None,
+        input_shape: Optional[Sequence[int]] = None,
+        layer_names: Optional[List[str]] = None,
+        seed: int = 0,
+    ):
+        self.builder = builder
+        self.builder_kwargs = dict(builder_kwargs or {})
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self._module = None
+        if variables is None:
+            if self.input_shape is None:
+                raise ValueError("need input_shape to initialize variables")
+            variables = self.module.init(
+                {"params": jax.random.PRNGKey(seed)},
+                jnp.zeros((1, *self.input_shape), jnp.float32),
+            )
+        self._variables = _to_numpy(variables)
+        if layer_names is None:
+            layer_names = getattr(self.module, "layer_names", None) or self._infer_layer_names()
+        self.layer_names = list(layer_names)
+
+    def _infer_layer_names(self) -> List[str]:
+        from .resnet import LAYER_NAMES, ResNet
+
+        if isinstance(self.module, ResNet):
+            return list(LAYER_NAMES)
+        return []
+
+    @property
+    def module(self):
+        if self._module is None:
+            factory = _BUILDERS[self.builder]
+            self._module = factory(**self.builder_kwargs)
+        return self._module
+
+    @property
+    def variables(self):
+        return self._variables
+
+    @variables.setter
+    def variables(self, v):
+        self._variables = _to_numpy(v)
+
+    def apply(self, variables, batch: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        out = self.module.apply(variables, batch, train=False)
+        if isinstance(out, tuple) and len(out) == 2 and isinstance(out[1], dict):
+            _, taps = out
+            return taps
+        if isinstance(out, dict):
+            return out
+        return {"output": out}
+
+    # pickle support: drop the live module (rebuilt lazily)
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_module"] = None
+        return d
+
+
+class FunctionBundle(ModelBundle):
+    """Arbitrary picklable `fn(variables, batch) -> dict|array` — the escape
+    hatch matching CNTKModel's arbitrary-graph generality."""
+
+    def __init__(self, fn, variables=None, input_shape=None, layer_names=None):
+        self.fn = fn
+        self._variables = _to_numpy(variables) if variables is not None else {}
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.layer_names = list(layer_names or ["output"])
+
+    @property
+    def variables(self):
+        return self._variables
+
+    def apply(self, variables, batch):
+        out = self.fn(variables, batch)
+        return out if isinstance(out, dict) else {"output": out}
+
+
+# register the resnet family
+def _register_defaults():
+    from . import resnet as R
+
+    for name in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152"):
+        register_builder(name, getattr(R, name))
+
+
+_register_defaults()

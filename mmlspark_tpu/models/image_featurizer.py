@@ -1,0 +1,105 @@
+"""ImageFeaturizer: transfer-learning featurization on TPU.
+
+Reference: deep-learning/.../ImageFeaturizer.scala:40-197 — picks the output
+node as `layerNames(cutOutputLayers)`, auto-resizes inputs to the model's
+input shape (ResizeImageTransformer + UnrollImage for image rows,
+UnrollBinaryImage for raw bytes), drops NA rows, delegates to CNTKModel.
+Here the whole path (resize -> normalize -> forward -> tap fetch) is one
+jitted XLA program per shape group via ImageTransformer + TPUModel.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+from ..core.registry import register_stage
+from ..core.schema import Table, find_unused_column_name
+from ..io.image import image_row_to_array
+from ..ops.image_stages import ResizeImageTransformer, _decode_cell
+from .bundle import ModelBundle
+from .tpu_model import TPUModel
+
+__all__ = ["ImageFeaturizer"]
+
+# ImageNet BGR mean/std in 0-255 scale (images arrive BGR uint8)
+IMAGENET_MEAN_BGR = [103.53, 116.28, 123.675]
+IMAGENET_STD_BGR = [57.375, 57.12, 58.395]
+
+
+@register_stage
+class ImageFeaturizer(Transformer):
+    bundle = ComplexParam("ModelBundle backbone", default=None)
+    model_name = Param("zoo model name (used when bundle unset)", default="resnet50")
+    input_col = Param("image column (image rows or encoded bytes)", default="image")
+    output_col = Param("feature column", default="features")
+    cut_output_layers = Param(
+        "how many output layers to cut: 0 = logits, 1 = pooled features "
+        "(ImageFeaturizer.scala cutOutputLayers)",
+        default=1, converter=TypeConverters.to_int)
+    drop_na = Param("drop undecodable rows", default=True, converter=TypeConverters.to_bool)
+    batch_size = Param("device minibatch size", default=64, converter=TypeConverters.to_int)
+    normalize = Param("apply ImageNet mean/std normalization", default=True,
+                      converter=TypeConverters.to_bool)
+
+    def __init__(self, bundle: Optional[ModelBundle] = None, **kw):
+        super().__init__(**kw)
+        if bundle is not None:
+            self.set(bundle=bundle)
+
+    def _get_bundle(self) -> ModelBundle:
+        b = self.bundle
+        if b is None:
+            from .zoo import get_or_create_resnet
+
+            b = get_or_create_resnet(self.model_name)
+            self.set(bundle=b)
+        return b
+
+    def _transform(self, table: Table) -> Table:
+        bundle = self._get_bundle()
+        if bundle.input_shape is None:
+            raise ValueError("ImageFeaturizer: bundle must declare input_shape")
+        h, w, _c = bundle.input_shape
+
+        cells = [_decode_cell(v) for v in table[self.input_col]]
+        keep = np.array([c is not None for c in cells])
+        if self.drop_na:
+            table = table.filter(keep)
+            cells = [c for c in cells if c is not None]
+        elif not keep.all():
+            raise ValueError("ImageFeaturizer: undecodable rows and drop_na=False")
+
+        tmp_img = find_unused_column_name("__resized__", table.column_names)
+        with_imgs = table.with_column(tmp_img, cells)
+        resized = ResizeImageTransformer(
+            input_col=tmp_img, output_col=tmp_img, height=h, width=w
+        ).transform(with_imgs)
+
+        batch = np.stack(
+            [image_row_to_array(r) for r in resized[tmp_img]]
+        ).astype(np.float32) if table.num_rows else np.zeros((0, h, w, _c), np.float32)
+        if self.normalize:
+            batch = (batch - np.asarray(IMAGENET_MEAN_BGR, np.float32)) / np.asarray(
+                IMAGENET_STD_BGR, np.float32
+            )
+        tmp_feed = find_unused_column_name("__feed__", resized.column_names)
+        feed = resized.with_column(tmp_feed, batch)
+
+        fetch = bundle.layer_names[self.cut_output_layers]
+        model = TPUModel(
+            bundle=bundle,
+            input_col=tmp_feed,
+            output_col=self.output_col,
+            fetch_node=fetch,
+            batch_size=self.batch_size,
+        )
+        out = model.transform(feed)
+        return out.drop(tmp_img, tmp_feed)
+
+    def transform_schema(self, columns: List[str]) -> List[str]:
+        if self.input_col not in columns:
+            raise ValueError(f"ImageFeaturizer: missing input column '{self.input_col}'")
+        return columns + [self.output_col]

@@ -1,0 +1,145 @@
+"""ResNet family in Flax — the flagship vision backbone.
+
+Replaces the reference's CNTK model-zoo CNNs (ResNet-50 ImageFeaturizer,
+SURVEY.md §2.5/§2.9.6).  TPU-first choices: NHWC layout, bfloat16 compute with
+float32 params/BN stats (MXU-native), and named feature taps so
+ImageFeaturizer's `cutOutputLayers` semantics (ImageFeaturizer.scala:40-197)
+address intermediate layers exactly like CNTK node names.
+
+Every apply returns `(logits, taps)` where `taps` maps layer names, ordered
+output-backwards: ["logits", "pool", "res5", "res4", "res3", "res2", "stem"].
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+           "LAYER_NAMES", "init_resnet"]
+
+LAYER_NAMES = ["logits", "pool", "res5", "res4", "res3", "res2", "stem"]
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides), padding=[(1, 1), (1, 1)])(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), (self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides), padding=[(1, 1), (1, 1)])(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), (self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        taps: Dict[str, jnp.ndarray] = {}
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=self.dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        taps["stem"] = x
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=64 * 2**i, strides=strides, dtype=self.dtype
+                )(x, train=train)
+            taps[f"res{i + 2}"] = x
+        x = jnp.mean(x, axis=(1, 2))
+        taps["pool"] = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        logits = logits.astype(jnp.float32)
+        taps["logits"] = logits
+        return logits, taps
+
+
+def resnet18(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype)
+
+
+def resnet34(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype)
+
+
+def resnet50(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype)
+
+
+def resnet101(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype)
+
+
+def resnet152(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes, dtype)
+
+
+_BUILDERS = {
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "resnet152": resnet152,
+}
+
+
+def build_resnet(name: str, num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return _BUILDERS[name](num_classes, dtype)
+
+
+def init_resnet(model: ResNet, input_shape=(1, 224, 224, 3), seed: int = 0):
+    """Initialize variables: {'params':..., 'batch_stats':...}."""
+    rng = jax.random.PRNGKey(seed)
+    return model.init({"params": rng}, jnp.zeros(input_shape, jnp.float32), train=False)

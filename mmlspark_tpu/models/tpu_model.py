@@ -1,0 +1,123 @@
+"""TPUModel: batched sharded model inference as a pipeline stage.
+
+The CNTKModel equivalent (deep-learning/.../CNTKModel.scala:88-545), designed
+TPU-first: instead of broadcast-bytes + per-partition JNI sessions
+(applyModel :88-140, mapPartitions :526), the weights are device_put once
+with a replicated sharding over the mesh and inputs stream through minibatch
+-> pad-to-static-shape -> batch-sharded device_put -> ONE jitted forward
+whose XLA program is cached across batches.  Feed/fetch-node addressing
+(:229-371) maps to the bundle's named taps; input coercion (:450-466) and
+output coercion (:468-493) are handled host-side.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+from ..core.registry import register_stage
+from ..core.schema import Table
+from ..parallel.mesh import batch_sharding, default_mesh, pad_to_multiple, replicated_sharding
+from .bundle import ModelBundle
+
+__all__ = ["TPUModel"]
+
+# process-wide cache: bundle-id -> (device variables, jitted fn, mesh)
+_EXEC_CACHE: Dict[int, Any] = {}
+
+
+def _gather_input(col: np.ndarray, input_shape) -> np.ndarray:
+    """Rows (vectors / arrays / scalars) -> [B, ...] float32, reshaping flat
+    CHW vectors to the bundle's input shape when given (coerceDFAndFeedDict,
+    CNTKModel.scala:450-466)."""
+    if col.dtype != object:
+        batch = np.asarray(col, dtype=np.float32)
+    else:
+        batch = np.stack([np.asarray(v, dtype=np.float32) for v in col])
+    if input_shape is not None and batch.shape[1:] != tuple(input_shape):
+        if int(np.prod(batch.shape[1:])) == int(np.prod(input_shape)):
+            # flat CHW vector -> HWC image (UnrollImage layout, c*h*w)
+            h, w, c = input_shape
+            batch = batch.reshape(batch.shape[0], c, h, w).transpose(0, 2, 3, 1)
+        else:
+            raise ValueError(
+                f"input rows of shape {batch.shape[1:]} incompatible with model "
+                f"input {tuple(input_shape)}"
+            )
+    return batch
+
+
+@register_stage
+class TPUModel(Transformer):
+    bundle = ComplexParam("ModelBundle (architecture + weights)")
+    input_col = Param("input column", default="features")
+    output_col = Param("output column", default="output")
+    fetch_node = Param("tap name or OUTPUT_i index to fetch", default=None)
+    batch_size = Param("device minibatch size", default=64,
+                       converter=TypeConverters.to_int)
+    convert_output_to = Param("none|vector|array", default="vector")
+
+    def __init__(self, bundle: Optional[ModelBundle] = None, **kw):
+        super().__init__(**kw)
+        if bundle is not None:
+            self.set(bundle=bundle)
+
+    # ---- node addressing (CNTKModel.scala:229-371) --------------------
+    def _fetch_name(self, bundle: ModelBundle) -> str:
+        node = self.fetch_node
+        names = bundle.layer_names or ["output"]
+        if node is None:
+            return names[0]
+        if isinstance(node, int) or (isinstance(node, str) and node.startswith("OUTPUT_")):
+            idx = node if isinstance(node, int) else int(node.split("_", 1)[1])
+            return names[idx]
+        return node
+
+    def _executor(self, bundle: ModelBundle, fetch: str):
+        """Build (or reuse) the sharded jitted forward for this bundle."""
+        mesh = default_mesh()
+        key = (id(bundle), fetch, tuple(sorted(mesh.shape.items())))
+        cached = _EXEC_CACHE.get(key)
+        if cached is not None:
+            return cached
+        dev_vars = jax.device_put(bundle.variables, replicated_sharding(mesh))
+
+        def forward(variables, batch):
+            taps = bundle.apply(variables, batch)
+            if fetch not in taps:
+                raise KeyError(
+                    f"fetch node {fetch!r} not in model taps {list(taps)}"
+                )
+            return taps[fetch].astype(jnp.float32)
+
+        jitted = jax.jit(forward)
+        _EXEC_CACHE[key] = (dev_vars, jitted, mesh)
+        return _EXEC_CACHE[key]
+
+    def _transform(self, table: Table) -> Table:
+        bundle: ModelBundle = self.bundle
+        fetch = self._fetch_name(bundle)
+        dev_vars, jitted, mesh = self._executor(bundle, fetch)
+        dp = mesh.shape["data"]
+        batch_np = _gather_input(table[self.input_col], bundle.input_shape)
+        outs: List[np.ndarray] = []
+        bs = max(self.batch_size, dp)
+        for start in range(0, len(batch_np), bs):
+            chunk = batch_np[start : start + bs]
+            padded, n = pad_to_multiple(chunk, dp, axis=0)
+            x = jax.device_put(padded, batch_sharding(mesh, padded.ndim))
+            y = np.asarray(jitted(dev_vars, x))[:n]
+            outs.append(y)
+        result = np.concatenate(outs, axis=0) if outs else np.zeros((0,))
+        if self.convert_output_to == "vector" and result.ndim > 2:
+            result = result.reshape(len(result), -1)
+        return table.with_column(self.output_col, result)
+
+    def transform_schema(self, columns: List[str]) -> List[str]:
+        if self.input_col not in columns:
+            raise ValueError(f"TPUModel: missing input column '{self.input_col}'")
+        return columns + [self.output_col]

@@ -1,0 +1,9 @@
+from .mesh import (
+    MeshContext,
+    batch_sharding,
+    default_mesh,
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+from .distributed import initialize_distributed, barrier

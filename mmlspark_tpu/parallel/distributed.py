@@ -1,0 +1,57 @@
+"""Multi-host rendezvous + barrier: the control-plane replacement for the
+reference's driver socket handshakes.
+
+Reference: lightgbm/LightGBMBase.scala:392-430 (createDriverNodesThread:
+ServerSocket rendezvous collecting host:port from every task) and
+vw/VowpalWabbitBase.scala:434-462 (spanning-tree daemon) — on TPU both are
+replaced by `jax.distributed.initialize` against the coordination service;
+data-plane AllReduce is XLA collectives over ICI/DCN, not TCP rings.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = ["initialize_distributed", "barrier", "is_coordinator"]
+
+_INITIALIZED = {"done": False}
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host job.  No-ops for single-process jobs and when the
+    TPU runtime already auto-initialized (standard on Cloud TPU VMs).
+    Env fallbacks: COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID.
+    """
+    if _INITIALIZED["done"] or jax.process_count() > 1:
+        _INITIALIZED["done"] = True
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        _INITIALIZED["done"] = True  # single host
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes or os.environ.get("NUM_PROCESSES", 1)),
+        process_id=int(process_id if process_id is not None else os.environ.get("PROCESS_ID", 0)),
+    )
+    _INITIALIZED["done"] = True
+
+
+def barrier(name: str = "barrier") -> None:
+    """Gang-sync all hosts (BarrierTaskContext.barrier() analog,
+    lightgbm/TrainUtils.scala:259-266).  A tiny psum across all devices forces
+    a global collective, which only completes when every host participates."""
+    x = jax.numpy.ones((jax.local_device_count(),))
+    out = jax.pmap(lambda v: jax.lax.psum(v, axis_name="i"), axis_name="i")(x)
+    np.asarray(out)  # block
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0

@@ -1,0 +1,107 @@
+"""Device mesh management: the framework's parallelism substrate.
+
+The reference's parallelism is Spark partitions + sockets (SURVEY.md §2.10);
+here every distributed computation runs SPMD over a `jax.sharding.Mesh` with
+named axes:
+
+    data    — batch/data parallel (the reference's mapPartitions analog)
+    model   — tensor parallel (reserved; reference has none)
+    seq     — sequence/context parallel for long inputs (ring attention)
+
+XLA inserts the collectives (psum/all_gather/reduce_scatter) from sharding
+annotations; they ride ICI within a slice and DCN across slices.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "MeshContext",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "pad_to_multiple",
+]
+
+_CURRENT: Dict[str, Optional[Mesh]] = {"mesh": None}
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data, model, seq) mesh.  `data=-1` absorbs remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data == -1:
+        if n % (model * seq) != 0:
+            raise ValueError(f"{n} devices not divisible by model*seq={model * seq}")
+        data = n // (model * seq)
+    if data * model * seq != n:
+        raise ValueError(f"mesh {data}x{model}x{seq} != {n} devices")
+    arr = np.asarray(devices).reshape(data, model, seq)
+    return Mesh(arr, axis_names=("data", "model", "seq"))
+
+
+def default_mesh() -> Mesh:
+    """The ambient mesh: explicitly-entered MeshContext, else all devices on
+    the data axis."""
+    if _CURRENT["mesh"] is not None:
+        return _CURRENT["mesh"]
+    return make_mesh()
+
+
+@contextlib.contextmanager
+def MeshContext(mesh: Mesh):
+    prev = _CURRENT["mesh"]
+    _CURRENT["mesh"] = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _CURRENT["mesh"] = prev
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1, batch_axis: int = 0) -> NamedSharding:
+    """Shard the batch axis over 'data'; everything else replicated."""
+    spec = [None] * ndim
+    spec[batch_axis] = "data"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0) -> Tuple[np.ndarray, int]:
+    """Pad `axis` up to a multiple (static shapes for XLA; padded rows are
+    dropped after unbatching).  Returns (padded, original_len)."""
+    n = arr.shape[axis]
+    target = math.ceil(max(n, 1) / multiple) * multiple
+    if target == n:
+        return arr, n
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, target - n)
+    return np.pad(arr, pad_width, mode="edge"), n
+
+
+def shard_batch(arr: np.ndarray, mesh: Optional[Mesh] = None) -> Tuple[jax.Array, int]:
+    """Pad the leading axis to the data-parallel degree and device_put with a
+    batch sharding — the device-feed path replacing the reference's
+    mapPartitions dispatch (CNTKModel.scala:526-531).
+    """
+    mesh = mesh or default_mesh()
+    dp = mesh.shape["data"]
+    padded, n = pad_to_multiple(np.asarray(arr), dp, axis=0)
+    out = jax.device_put(padded, batch_sharding(mesh, padded.ndim))
+    return out, n

@@ -242,7 +242,8 @@ class ClassBalancerModel(Model):
 
     def _transform(self, table: Table) -> Table:
         w = self.weights
-        out = np.array([w[v.item() if isinstance(v, np.generic) else v]
+        # unseen labels get NaN, matching the reference's left-join nulls
+        out = np.array([w.get(v.item() if isinstance(v, np.generic) else v, np.nan)
                         for v in table[self.input_col]])
         return table.with_column(self.output_col, out)
 
@@ -328,15 +329,27 @@ class StratifiedRepartition(Transformer):
     label_col = Param("label column", default="label")
     n = Param("number of partitions", default=None, converter=TypeConverters.to_int)
     mode = Param("equal|original|mixed", default="equal")
+    seed = Param("shuffle seed for mixed mode", default=0, converter=TypeConverters.to_int)
 
     def _transform(self, table: Table) -> Table:
         from ..utils.cluster import get_num_shards
 
         n = self.n or get_num_shards()
-        labels = table[self.label_col]
         part = np.zeros(table.num_rows, dtype=np.int32)
-        for _, idxs in table.group_indices(self.label_col).items():
-            part[idxs] = np.arange(len(idxs)) % n
+        if self.mode == "equal":
+            # every partition gets an equal share of every class
+            for _, idxs in table.group_indices(self.label_col).items():
+                part[idxs] = np.arange(len(idxs)) % n
+        elif self.mode == "original":
+            # preserve the incoming class distribution per partition
+            part = np.arange(table.num_rows, dtype=np.int32) % n
+        elif self.mode == "mixed":
+            # equal shares, shuffled within each class
+            rng = np.random.default_rng(self.seed)
+            for _, idxs in table.group_indices(self.label_col).items():
+                part[idxs] = rng.permutation(len(idxs)) % n
+        else:
+            raise ValueError(f"StratifiedRepartition: unknown mode {self.mode!r}")
         out = table.with_column("__partition__", part)
         return out.with_meta("__partitioning__", {"num_partitions": n})
 

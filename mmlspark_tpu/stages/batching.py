@@ -73,7 +73,11 @@ class FixedMiniBatchTransformer(_MiniBatchBase):
     """
 
     batch_size = Param("rows per batch", default=32, converter=TypeConverters.to_int)
-    buffered = Param("prefetch batches on a background thread", default=False,
+    # `buffered` is API parity with the reference; on a materialized Table the
+    # output is eager either way, so prefetch would add no overlap here.  The
+    # streaming double-buffer lives in core.batching.FixedBufferedBatcher and
+    # the TPUModel device feed.
+    buffered = Param("kept for API parity (Tables are materialized)", default=False,
                      converter=TypeConverters.to_bool)
     max_buffer_size = Param("max buffered batches", default=2,
                             converter=TypeConverters.to_int)
@@ -84,17 +88,7 @@ class FixedMiniBatchTransformer(_MiniBatchBase):
             (s, min(s + self.batch_size, table.num_rows))
             for s in range(0, table.num_rows, self.batch_size)
         ]
-        if self.buffered:
-            rows = list(
-                FixedBufferedBatcher(
-                    (_stack_batch(table, a, b) for a, b in bounds),
-                    batch_size=1,
-                    buffer_size=self.max_buffer_size,
-                )
-            )
-            rows = [r[0] for r in rows]
-        else:
-            rows = [_stack_batch(table, a, b) for a, b in bounds]
+        rows = [_stack_batch(table, a, b) for a, b in bounds]
         return _batches_to_table(rows, names)
 
 
@@ -148,14 +142,19 @@ class FlattenBatch(Transformer):
         names = table.column_names
         out_cols: dict = {n: [] for n in names}
         for i in range(table.num_rows):
-            lengths = []
+            lengths = set()
             vals = {}
             for n in names:
                 v = table.columns[n][i]
                 vals[n] = v
                 if isinstance(v, (list, np.ndarray)):
-                    lengths.append(len(v))
-            size = max(lengths) if lengths else 1
+                    lengths.add(len(v))
+            if len(lengths) > 1:
+                raise ValueError(
+                    f"FlattenBatch: batch row {i} has mismatched column lengths "
+                    f"{sorted(lengths)}; refusing to silently misalign rows"
+                )
+            size = lengths.pop() if lengths else 1
             for n in names:
                 v = vals[n]
                 if isinstance(v, (list, np.ndarray)) and len(v) == size:

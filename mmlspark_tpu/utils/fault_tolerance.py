@@ -14,15 +14,25 @@ T = TypeVar("T")
 
 
 def retry_with_timeout(fn: Callable[[], T], timeout_sec: float, retries: int = 3) -> T:
-    """Run `fn` with a wall-clock timeout, retrying on failure/timeout."""
+    """Run `fn` with a wall-clock timeout, retrying on failure/timeout.
+
+    The timeout is enforced at the caller: on expiry the attempt is abandoned
+    (its daemon thread may still run to completion in the background — Python
+    cannot kill threads) and the next retry starts immediately.  Only safe for
+    idempotent operations, same as the reference's retryWithTimeout.
+    """
     last: Optional[BaseException] = None
     for _ in range(retries):
-        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
-            fut = ex.submit(fn)
-            try:
-                return fut.result(timeout=timeout_sec)
-            except Exception as e:  # noqa: BLE001
-                last = e
+        ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="retry_with_timeout"
+        )
+        fut = ex.submit(fn)
+        try:
+            return fut.result(timeout=timeout_sec)
+        except Exception as e:  # noqa: BLE001
+            last = e
+        finally:
+            ex.shutdown(wait=False)
     raise last  # type: ignore[misc]
 
 

@@ -1,0 +1,144 @@
+"""Image ops + image stage tests (OpenCV-parity semantics)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.io.image import (
+    array_to_image_row,
+    decode_image,
+    encode_image_row,
+    image_row_to_array,
+    safe_read,
+)
+from mmlspark_tpu.ops import image as I
+from mmlspark_tpu.ops.image_stages import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollBinaryImage,
+    UnrollImage,
+)
+
+from fuzzing import fuzz
+
+
+def _rand_img(rng, h=16, w=12, c=3):
+    return rng.integers(0, 255, size=(h, w, c)).astype(np.uint8)
+
+
+@pytest.fixture
+def img_table(rng):
+    rows = [array_to_image_row(_rand_img(rng), origin=f"img{i}") for i in range(6)]
+    return Table({"image": rows, "id": np.arange(6)})
+
+
+class TestImageIO:
+    def test_encode_decode_roundtrip(self, rng):
+        row = array_to_image_row(_rand_img(rng))
+        data = encode_image_row(row, "PNG")
+        back = decode_image(data)
+        np.testing.assert_array_equal(image_row_to_array(back), image_row_to_array(row))
+
+    def test_safe_read_garbage(self):
+        assert safe_read(b"not an image") is None
+        assert safe_read(None) is None
+
+
+class TestOps:
+    def test_resize_shapes(self):
+        b = np.zeros((2, 8, 8, 3), np.float32)
+        out = I.resize(b, 4, 6)
+        assert out.shape == (2, 4, 6, 3)
+
+    def test_flip(self):
+        b = np.arange(8, dtype=np.float32).reshape(1, 2, 4, 1)
+        lr = np.asarray(I.flip(b, True, False))
+        np.testing.assert_array_equal(lr[0, 0, :, 0], [3, 2, 1, 0])
+        ud = np.asarray(I.flip(b, False, True))
+        np.testing.assert_array_equal(ud[0, :, 0, 0], [4, 0])
+
+    def test_color_convert_gray_matches_opencv_weights(self):
+        bgr = np.array([[[[100.0, 50.0, 200.0]]]], np.float32)
+        gray = float(np.asarray(I.color_convert(bgr, "bgr2gray"))[0, 0, 0, 0])
+        assert gray == pytest.approx(0.114 * 100 + 0.587 * 50 + 0.299 * 200, rel=1e-5)
+
+    def test_threshold_kinds(self):
+        b = np.array([[[[10.0], [200.0]]]], np.float32)
+        assert np.asarray(I.threshold(b, 100, 255, "binary")).ravel().tolist() == [0, 255]
+        assert np.asarray(I.threshold(b, 100, 255, "trunc")).ravel().tolist() == [10, 100]
+
+    def test_gaussian_kernel_normalized(self):
+        k = I.gaussian_kernel(5, 1.2)
+        assert k.shape == (5, 5)
+        assert k.sum() == pytest.approx(1.0, abs=1e-6)
+
+    def test_blur_preserves_constant(self):
+        b = np.full((1, 8, 8, 3), 7.0, np.float32)
+        out = np.asarray(I.gaussian_blur(b, 3, 1.0))
+        np.testing.assert_allclose(out[0, 2:6, 2:6], 7.0, rtol=1e-5)
+
+    def test_unroll_roundtrip(self):
+        b = np.arange(24, dtype=np.float32).reshape(1, 2, 4, 3)
+        flat = np.asarray(I.hwc_to_chw_flat(b))
+        assert flat.shape == (1, 24)
+        # CHW layout: first H*W entries are channel 0
+        np.testing.assert_array_equal(flat[0, :8], b[0, :, :, 0].ravel())
+        back = np.asarray(I.chw_flat_to_hwc(flat, 2, 4, 3))
+        np.testing.assert_array_equal(back, b)
+
+
+class TestImageStages:
+    def test_resize_stage(self, img_table):
+        out = ResizeImageTransformer(height=8, width=8).transform(img_table)
+        r = out["image"][0]
+        assert (r["height"], r["width"]) == (8, 8)
+
+    def test_image_transformer_pipeline(self, img_table):
+        t = ImageTransformer()
+        t.resize(10, 10).center_crop(8, 8).flip()
+        out = t.transform(img_table)
+        r = out["image"][0]
+        assert (r["height"], r["width"]) == (8, 8)
+
+    def test_image_transformer_matches_numpy_flip(self, img_table):
+        t = ImageTransformer()
+        t.flip(flip_left_right=True)
+        out = t.transform(img_table)
+        src = image_row_to_array(img_table["image"][0])
+        got = image_row_to_array(out["image"][0])
+        np.testing.assert_array_equal(got, src[:, ::-1, :])
+
+    def test_image_transformer_fuzz(self, img_table):
+        t = ImageTransformer()
+        t.resize(8, 8)
+        fuzz(t, img_table)
+
+    def test_mixed_shapes_grouped(self, rng):
+        rows = [array_to_image_row(_rand_img(rng, 16, 16)),
+                array_to_image_row(_rand_img(rng, 8, 8))]
+        t = Table({"image": rows})
+        out = ResizeImageTransformer(height=4, width=4).transform(t)
+        assert all(r["height"] == 4 for r in out["image"])
+
+    def test_none_rows_passthrough(self, rng):
+        rows = [array_to_image_row(_rand_img(rng)), None]
+        out = ResizeImageTransformer(height=4, width=4).transform(Table({"image": rows}))
+        assert out["image"][1] is None
+
+    def test_unroll_image(self, img_table):
+        out = UnrollImage().transform(img_table)
+        v = out["unrolled"][0]
+        assert v.shape == (16 * 12 * 3,)
+        src = image_row_to_array(img_table["image"][0]).astype(np.float64)
+        np.testing.assert_allclose(v[: 16 * 12], src[:, :, 0].ravel())
+
+    def test_unroll_binary_image(self, rng):
+        img = _rand_img(rng, 8, 8)
+        data = encode_image_row(array_to_image_row(img), "PNG")
+        t = Table({"bytes": [data]})
+        out = UnrollBinaryImage(height=4, width=4).transform(t)
+        assert out["unrolled"][0].shape == (4 * 4 * 3,)
+
+    def test_augmenter_doubles_rows(self, img_table):
+        out = ImageSetAugmenter().transform(img_table)
+        assert out.num_rows == 12

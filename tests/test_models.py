@@ -1,0 +1,129 @@
+"""TPUModel / ImageFeaturizer / zoo tests — small shapes, 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.io.image import array_to_image_row
+from mmlspark_tpu.models.bundle import FlaxBundle, FunctionBundle
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu.models.tpu_model import TPUModel
+from mmlspark_tpu.models.zoo import ModelRepo
+from mmlspark_tpu.parallel.mesh import make_mesh, MeshContext
+
+from fuzzing import fuzz
+
+
+@pytest.fixture(scope="module")
+def tiny_resnet():
+    import jax.numpy as jnp
+
+    return FlaxBundle(
+        "resnet18", {"num_classes": 10, "dtype": jnp.float32},
+        input_shape=(32, 32, 3), seed=0,
+    )
+
+
+class TestBundle:
+    def test_apply_taps(self, tiny_resnet):
+        x = np.zeros((2, 32, 32, 3), np.float32)
+        taps = tiny_resnet.apply(tiny_resnet.variables, x)
+        assert taps["logits"].shape == (2, 10)
+        assert taps["pool"].shape == (2, 512)
+        assert tiny_resnet.layer_names[0] == "logits"
+
+    def test_function_bundle(self):
+        fb = FunctionBundle(lambda v, x: x * 2.0, input_shape=(3,))
+        out = fb.apply({}, np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(out["output"], 2.0)
+
+
+class TestTPUModel:
+    def test_transform_logits(self, tiny_resnet, rng):
+        t = Table({"x": rng.normal(size=(10, 32, 32, 3)).astype(np.float32)})
+        m = TPUModel(bundle=tiny_resnet, input_col="x", output_col="y",
+                     fetch_node="logits", batch_size=8)
+        out = m.transform(t)
+        assert out["y"].shape == (10, 10)
+
+    def test_indexed_fetch(self, tiny_resnet, rng):
+        t = Table({"x": rng.normal(size=(3, 32, 32, 3)).astype(np.float32)})
+        m = TPUModel(bundle=tiny_resnet, input_col="x", output_col="y",
+                     fetch_node="OUTPUT_1")
+        assert m.transform(t)["y"].shape == (3, 512)  # pool tap
+
+    def test_flat_vector_input_reshaped(self, tiny_resnet, rng):
+        flat = rng.normal(size=(4, 3 * 32 * 32)).astype(np.float32)
+        t = Table({"x": flat})
+        m = TPUModel(bundle=tiny_resnet, input_col="x", output_col="y")
+        assert m.transform(t)["y"].shape == (4, 10)
+
+    def test_sharded_equals_unsharded(self, tiny_resnet, rng):
+        """Batch-sharded inference over the 8-device mesh must match the
+        single-device result (pad/shard/unpad correctness)."""
+        x = rng.normal(size=(5, 32, 32, 3)).astype(np.float32)  # 5 % 8 != 0
+        t = Table({"x": x})
+        m = TPUModel(bundle=tiny_resnet, input_col="x", output_col="y",
+                     fetch_node="logits")
+        with MeshContext(make_mesh(data=8)):
+            sharded = m.transform(t)["y"]
+        with MeshContext(make_mesh(data=1, devices=jax.devices()[:1])):
+            single = m.transform(t)["y"]
+        np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=2e-4)
+
+    def test_roundtrip(self, tiny_resnet, rng):
+        t = Table({"x": rng.normal(size=(4, 32, 32, 3)).astype(np.float32)})
+        m = TPUModel(bundle=tiny_resnet, input_col="x", output_col="y")
+        fuzz(m, t, rtol=1e-3)
+
+
+class TestZoo:
+    def test_publish_load_verify(self, tmp_path, tiny_resnet):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        schema = repo.publish("tiny", tiny_resnet, dataset="test")
+        assert "tiny" in repo.list_models()
+        loaded = repo.load("tiny")
+        assert loaded.layer_names == tiny_resnet.layer_names
+        assert schema.sha256
+
+    def test_corrupted_model_raises(self, tmp_path, tiny_resnet):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        repo.publish("tiny", tiny_resnet)
+        with open(repo.get_schema("tiny").uri, "ab") as f:
+            f.write(b"corruption")
+        with pytest.raises(IOError):
+            repo.load("tiny", retries=2)
+
+    def test_repo_transfer(self, tmp_path, tiny_resnet):
+        src = ModelRepo(str(tmp_path / "src"))
+        dst = ModelRepo(str(tmp_path / "dst"))
+        src.publish("tiny", tiny_resnet)
+        dst.download_from(src, "tiny")
+        assert dst.load("tiny").layer_names == tiny_resnet.layer_names
+
+
+class TestImageFeaturizer:
+    def test_featurize_images(self, tiny_resnet, rng):
+        rows = [
+            array_to_image_row(rng.integers(0, 255, (40, 30, 3)).astype(np.uint8))
+            for _ in range(5)
+        ]
+        t = Table({"image": rows, "id": np.arange(5)})
+        f = ImageFeaturizer(bundle=tiny_resnet, cut_output_layers=1, batch_size=4)
+        out = f.transform(t)
+        assert out["features"].shape == (5, 512)
+        assert "id" in out
+
+    def test_cut_zero_gives_logits(self, tiny_resnet, rng):
+        rows = [array_to_image_row(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8))]
+        out = ImageFeaturizer(bundle=tiny_resnet, cut_output_layers=0).transform(
+            Table({"image": rows})
+        )
+        assert out["features"].shape == (1, 10)
+
+    def test_drop_na(self, tiny_resnet, rng):
+        rows = [array_to_image_row(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)),
+                b"garbage-not-an-image"]
+        out = ImageFeaturizer(bundle=tiny_resnet).transform(Table({"image": rows}))
+        assert out.num_rows == 1
