@@ -24,6 +24,7 @@ STAGE_MODULES = [
     "mmlspark_tpu.featurize.value_indexer",
     "mmlspark_tpu.featurize.clean_missing",
     "mmlspark_tpu.featurize.text",
+    "mmlspark_tpu.models.linear",
     "mmlspark_tpu.models.train_classifier",
     "mmlspark_tpu.models.statistics",
     "mmlspark_tpu.gbdt.estimators",

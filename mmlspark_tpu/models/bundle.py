@@ -8,6 +8,7 @@ CNTK-style node addressing for feed/fetch dicts (CNTKModel.scala:229-371).
 """
 from __future__ import annotations
 
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,10 +31,20 @@ def _to_numpy(tree):
 
 
 class ModelBundle:
-    """Interface: named-output model with weights."""
+    """Interface: named-output model with weights.
+
+    `bundle_id` is a stable identity for executor caching: unique per
+    construction, preserved through pickle (same weights -> same id), unlike
+    `id()` which CPython recycles.
+    """
 
     input_shape: Optional[Tuple[int, ...]] = None  # per-example, e.g. (224,224,3)
     layer_names: List[str] = []
+
+    def __new__(cls, *args, **kwargs):
+        obj = super().__new__(cls)
+        obj.bundle_id = uuid.uuid4().hex
+        return obj
 
     def apply(self, variables, batch: jnp.ndarray) -> Dict[str, jnp.ndarray]:
         raise NotImplementedError

@@ -1,0 +1,140 @@
+"""Native JAX linear learners: the default classifier/regressor family.
+
+The reference wraps SparkML learners inside TrainClassifier/TrainRegressor
+(train/TrainClassifier.scala:49); this framework's defaults are jit-compiled
+full-batch learners on the MXU — logistic regression (multinomial) and ridge
+linear regression — sharing the (features_col, label_col, prediction_col)
+contract every learner implements.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.registry import register_stage
+from ..core.schema import Table
+
+__all__ = [
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+]
+
+
+def _features_matrix(col: np.ndarray) -> np.ndarray:
+    if col.dtype == object:
+        return np.stack([np.asarray(v, dtype=np.float32) for v in col])
+    return np.asarray(col, dtype=np.float32)
+
+
+class _GDMixin:
+    def _optimize(self, loss_fn, params, steps: int, lr: float):
+        opt = optax.adam(lr)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, state = opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), state, loss
+
+        loss = None
+        for _ in range(steps):
+            params, state, loss = step(params, state)
+        return params, float(loss) if loss is not None else None
+
+
+@register_stage
+class LogisticRegression(Estimator, _GDMixin):
+    features_col = Param("features column", default="features")
+    label_col = Param("label column", default="label")
+    prediction_col = Param("prediction column", default="prediction")
+    probability_col = Param("probability column", default="scores")
+    reg_param = Param("L2 strength", default=1e-4, converter=TypeConverters.to_float)
+    max_iter = Param("gradient steps", default=200, converter=TypeConverters.to_int)
+    learning_rate = Param("adam lr", default=0.1, converter=TypeConverters.to_float)
+
+    def _fit(self, table: Table) -> "LogisticRegressionModel":
+        x = jnp.asarray(_features_matrix(table[self.features_col]))
+        y_np = np.asarray(table[self.label_col]).astype(np.int32)
+        n_classes = int(y_np.max()) + 1 if len(y_np) else 2
+        y = jnp.asarray(y_np)
+        d = x.shape[1]
+        params = {"w": jnp.zeros((d, n_classes)), "b": jnp.zeros((n_classes,))}
+        reg = self.reg_param
+
+        def loss_fn(p):
+            logits = x @ p["w"] + p["b"]
+            ll = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            return ll + reg * jnp.sum(p["w"] ** 2)
+
+        params, _ = self._optimize(loss_fn, params, self.max_iter, self.learning_rate)
+        return LogisticRegressionModel(
+            features_col=self.features_col,
+            prediction_col=self.prediction_col,
+            probability_col=self.probability_col,
+            weights={"w": np.asarray(params["w"]), "b": np.asarray(params["b"])},
+        )
+
+
+@register_stage
+class LogisticRegressionModel(Model):
+    features_col = Param("features column", default="features")
+    prediction_col = Param("prediction column", default="prediction")
+    probability_col = Param("probability column", default="scores")
+    weights = ComplexParam("dict with w [D,C] and b [C]")
+
+    @property
+    def num_classes(self) -> int:
+        return int(self.weights["b"].shape[0])
+
+    def _transform(self, table: Table) -> Table:
+        x = _features_matrix(table[self.features_col])
+        w, b = self.weights["w"], self.weights["b"]
+        logits = x @ w + b
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        preds = probs.argmax(axis=-1).astype(np.float64)
+        out = table.with_column(self.probability_col, probs)
+        return out.with_column(self.prediction_col, preds)
+
+
+@register_stage
+class LinearRegression(Estimator, _GDMixin):
+    features_col = Param("features column", default="features")
+    label_col = Param("label column", default="label")
+    prediction_col = Param("prediction column", default="prediction")
+    reg_param = Param("L2 (ridge) strength", default=1e-6,
+                      converter=TypeConverters.to_float)
+
+    def _fit(self, table: Table) -> "LinearRegressionModel":
+        x = _features_matrix(table[self.features_col]).astype(np.float64)
+        y = np.asarray(table[self.label_col], dtype=np.float64)
+        xb = np.concatenate([x, np.ones((len(x), 1))], axis=1)
+        # closed-form ridge: (X'X + λI)^-1 X'y — small-D path; jit for large D
+        d = xb.shape[1]
+        gram = xb.T @ xb + self.reg_param * np.eye(d)
+        wb = np.linalg.solve(gram, xb.T @ y)
+        return LinearRegressionModel(
+            features_col=self.features_col,
+            prediction_col=self.prediction_col,
+            weights={"w": wb[:-1], "b": wb[-1:]},
+        )
+
+
+@register_stage
+class LinearRegressionModel(Model):
+    features_col = Param("features column", default="features")
+    prediction_col = Param("prediction column", default="prediction")
+    weights = ComplexParam("dict with w [D] and b [1]")
+
+    def _transform(self, table: Table) -> Table:
+        x = _features_matrix(table[self.features_col]).astype(np.float64)
+        preds = x @ self.weights["w"] + self.weights["b"][0]
+        return table.with_column(self.prediction_col, preds)

@@ -11,6 +11,7 @@ output coercion (:468-493) are handled host-side.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -26,8 +27,10 @@ from .bundle import ModelBundle
 
 __all__ = ["TPUModel"]
 
-# process-wide cache: bundle-id -> (device variables, jitted fn, mesh)
-_EXEC_CACHE: Dict[int, Any] = {}
+# process-wide LRU cache: (bundle_id, fetch, mesh) -> (device vars, jit, mesh).
+# Bounded so device-resident weights of retired models get released.
+_EXEC_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
+_EXEC_CACHE_MAX = 8
 
 
 def _gather_input(col: np.ndarray, input_shape) -> np.ndarray:
@@ -80,9 +83,10 @@ class TPUModel(Transformer):
     def _executor(self, bundle: ModelBundle, fetch: str):
         """Build (or reuse) the sharded jitted forward for this bundle."""
         mesh = default_mesh()
-        key = (id(bundle), fetch, tuple(sorted(mesh.shape.items())))
+        key = (bundle.bundle_id, fetch, tuple(sorted(mesh.shape.items())))
         cached = _EXEC_CACHE.get(key)
         if cached is not None:
+            _EXEC_CACHE.move_to_end(key)
             return cached
         dev_vars = jax.device_put(bundle.variables, replicated_sharding(mesh))
 
@@ -96,6 +100,8 @@ class TPUModel(Transformer):
 
         jitted = jax.jit(forward)
         _EXEC_CACHE[key] = (dev_vars, jitted, mesh)
+        while len(_EXEC_CACHE) > _EXEC_CACHE_MAX:
+            _EXEC_CACHE.popitem(last=False)
         return _EXEC_CACHE[key]
 
     def _transform(self, table: Table) -> Table:

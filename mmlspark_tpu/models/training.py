@@ -137,12 +137,19 @@ def fit_epochs(
     seed: int = 0,
     log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
 ) -> Tuple[TrainState, Dict[str, float]]:
-    """Simple epoch loop over a host-resident dataset; batches are padded to
-    the data-parallel degree and device_put per step."""
+    """Simple epoch loop over a host-resident dataset.  `batch_size` must be
+    divisible by the mesh's data-parallel degree (static shapes; the remainder
+    of each epoch is dropped, standard for training loops)."""
     mesh = mesh or default_mesh()
     dp = mesh.shape["data"]
-    rng = np.random.default_rng(seed)
+    if batch_size % dp != 0:
+        raise ValueError(f"batch_size {batch_size} not divisible by data-parallel degree {dp}")
     n = len(images)
+    if n < batch_size:
+        raise ValueError(
+            f"dataset has {n} rows < batch_size {batch_size}; lower batch_size"
+        )
+    rng = np.random.default_rng(seed)
     metrics: Dict[str, float] = {}
     for _epoch in range(epochs):
         order = rng.permutation(n)

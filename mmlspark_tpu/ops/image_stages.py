@@ -69,7 +69,14 @@ class _BatchedImageStage(Transformer):
     def _pipeline_fn(self) -> Callable[[jnp.ndarray], jnp.ndarray]:
         raise NotImplementedError
 
+    def _float_output(self) -> bool:
+        """When True, emit float arrays instead of uint8 image rows (e.g. a
+        pipeline ending in normalize would be destroyed by uint8 clipping)."""
+        return False
+
     def _emit(self, out_batch: np.ndarray, src_rows: List[dict]) -> List[Any]:
+        if self._float_output():
+            return [np.asarray(a, dtype=np.float32) for a in out_batch]
         return [
             array_to_image_row(np.clip(a, 0, 255).astype(np.uint8),
                                origin=r.get("origin", ""))
@@ -150,6 +157,16 @@ class ImageTransformer(_BatchedImageStage):
 
     def normalize(self, mean, std, scale: float = 1.0):
         return self._add("normalize", mean=mean, std=std, scale=scale)
+
+    def _float_output(self) -> bool:
+        # a normalize (or sub-1 threshold) tail produces float-scale values;
+        # clipping those to uint8 would zero them out
+        for name, kwargs in self.stages or []:
+            if name == "normalize":
+                return True
+            if name == "threshold" and kwargs.get("maxVal", 255) <= 1.0:
+                return True
+        return False
 
     def _pipeline_fn(self):
         ops = [(self._OPS[name], dict(kwargs)) for name, kwargs in (self.stages or [])]

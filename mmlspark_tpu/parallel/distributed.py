@@ -29,18 +29,24 @@ def initialize_distributed(
     TPU runtime already auto-initialized (standard on Cloud TPU VMs).
     Env fallbacks: COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID.
     """
-    if _INITIALIZED["done"] or jax.process_count() > 1:
-        _INITIALIZED["done"] = True
+    if _INITIALIZED["done"]:
         return
     coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
     if coordinator_address is None:
-        _INITIALIZED["done"] = True  # single host
+        # single host — note: do NOT touch jax.process_count() before this
+        # point; it would initialize the local backend and make a later
+        # jax.distributed.initialize impossible
+        _INITIALIZED["done"] = True
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=int(num_processes or os.environ.get("NUM_PROCESSES", 1)),
-        process_id=int(process_id if process_id is not None else os.environ.get("PROCESS_ID", 0)),
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=int(num_processes or os.environ.get("NUM_PROCESSES", 1)),
+            process_id=int(process_id if process_id is not None else os.environ.get("PROCESS_ID", 0)),
+        )
+    except RuntimeError as e:
+        if "already" not in str(e).lower():  # runtime auto-initialized is fine
+            raise
     _INITIALIZED["done"] = True
 
 

@@ -1,0 +1,120 @@
+"""Featurization package tests."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.featurize import (
+    CleanMissingData,
+    CountSelector,
+    DataConversion,
+    Featurize,
+    IndexToValue,
+    MultiNGram,
+    PageSplitter,
+    TextFeaturizer,
+    ValueIndexer,
+)
+
+from fuzzing import fuzz
+
+
+@pytest.fixture
+def mixed_table(rng):
+    return Table({
+        "num": np.array([1.0, 2.0, np.nan, 4.0, 5.0]),
+        "cat": ["a", "b", "a", "c", "b"],
+        "text": ["the quick brown fox", "lazy dog sleeps", "fox and dog",
+                 "quick quick fox", "sleepy cat"],
+        "vec": rng.normal(size=(5, 3)),
+        "label": ["yes", "no", "yes", "no", "yes"],
+    })
+
+
+class TestValueIndexer:
+    def test_index_and_invert(self, mixed_table):
+        model, out = fuzz(ValueIndexer(input_col="label", output_col="idx"), mixed_table)
+        assert set(out["idx"]) == {0.0, 1.0}
+        restored = IndexToValue(input_col="idx", output_col="back").transform(out)
+        assert list(restored["back"]) == list(mixed_table["label"])
+
+    def test_unseen_value_raises(self, mixed_table):
+        model = ValueIndexer(input_col="label", output_col="idx").fit(mixed_table)
+        bad = Table({"label": ["maybe"]})
+        with pytest.raises(ValueError):
+            model.transform(bad)
+
+
+class TestCleanMissing:
+    def test_mean_impute(self, mixed_table):
+        model, out = fuzz(CleanMissingData(input_cols=["num"]), mixed_table)
+        assert out["num"][2] == pytest.approx(3.0)  # mean of 1,2,4,5
+
+    def test_median_and_custom(self, mixed_table):
+        m = CleanMissingData(input_cols=["num"], cleaning_mode="Median").fit(mixed_table)
+        assert m.fill_values["num"] == pytest.approx(3.0)
+        m2 = CleanMissingData(input_cols=["num"], cleaning_mode="Custom",
+                              custom_value=-1).fit(mixed_table)
+        assert m2.transform(mixed_table)["num"][2] == -1.0
+
+
+class TestFeaturize:
+    def test_assembles_all_kinds(self, mixed_table):
+        model, out = fuzz(
+            Featurize(input_cols=["num", "cat", "vec"], output_col="features"),
+            mixed_table,
+        )
+        f = out["features"]
+        # 1 numeric + 3 one-hot + 3 vector = 7 dims
+        assert f.shape == (5, 7)
+        assert not np.isnan(f).any()
+
+    def test_text_hashing_when_high_cardinality(self, mixed_table):
+        model = Featurize(input_cols=["text"], categorical_threshold=2,
+                          number_of_features=32).fit(mixed_table)
+        out = model.transform(mixed_table)
+        assert out["features"].shape == (5, 32)
+
+    def test_data_conversion(self, mixed_table):
+        out = DataConversion(cols=["num"], convert_to="integer").transform(
+            CleanMissingData(input_cols=["num"]).fit(mixed_table).transform(mixed_table)
+        )
+        assert out["num"].dtype == np.int32
+        out2 = DataConversion(cols=["cat"], convert_to="categorical").transform(mixed_table)
+        assert out2.get_meta("cat")["categorical"] is not None
+
+    def test_count_selector(self):
+        t = Table({"features": np.array([[1.0, 0.0, 2.0], [3.0, 0.0, 0.0]])})
+        model, out = fuzz(CountSelector(), t)
+        assert out["features"].shape == (2, 2)
+
+
+class TestTextFeaturizer:
+    def test_tfidf_pipeline(self, mixed_table):
+        model, out = fuzz(
+            TextFeaturizer(input_col="text", num_features=64, use_idf=True),
+            mixed_table,
+        )
+        f = out["features"]
+        assert f.shape == (5, 64)
+        assert (f >= 0).all() and f.sum() > 0
+
+    def test_stopwords_and_ngrams(self):
+        t = Table({"text": ["the cat sat on the mat"]})
+        m = TextFeaturizer(input_col="text", num_features=64,
+                           use_stop_words_remover=True, use_ngram=True,
+                           n_gram_length=2, use_idf=False).fit(t)
+        out = m.transform(t)
+        assert out["features"].sum() > 0
+
+    def test_multi_ngram(self):
+        t = Table({"tokens": [["a", "b", "c"]]})
+        out = MultiNGram(lengths=[1, 2]).transform(t)
+        assert out["ngrams"][0] == ["a", "b", "c", "a b", "b c"]
+
+    def test_page_splitter(self):
+        t = Table({"text": ["word " * 100]})
+        out = PageSplitter(maximum_page_length=80, minimum_page_length=40).transform(t)
+        pages = out["pages"][0]
+        assert len(pages) > 1
+        assert all(len(p) <= 80 for p in pages)
+        assert "".join(pages) == "word " * 100

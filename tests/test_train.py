@@ -1,0 +1,122 @@
+"""TrainClassifier / TrainRegressor / linear learners / statistics tests."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.linear import LinearRegression, LogisticRegression
+from mmlspark_tpu.models.statistics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    confusion_matrix,
+    roc_auc,
+)
+from mmlspark_tpu.models.train_classifier import TrainClassifier, TrainRegressor
+
+from fuzzing import fuzz
+
+
+@pytest.fixture
+def blobs(rng):
+    n = 60
+    x0 = rng.normal(loc=-2.0, size=(n // 2, 3))
+    x1 = rng.normal(loc=2.0, size=(n // 2, 3))
+    x = np.vstack([x0, x1]).astype(np.float32)
+    y = np.array([0] * (n // 2) + [1] * (n // 2))
+    idx = rng.permutation(n)
+    return Table({"features": x[idx], "label": y[idx]})
+
+
+class TestLinearLearners:
+    def test_logistic_separates_blobs(self, blobs):
+        model, out = fuzz(LogisticRegression(max_iter=150), blobs, rtol=1e-3)
+        acc = (out["prediction"] == blobs["label"]).mean()
+        assert acc > 0.95
+        assert out["scores"].shape == (60, 2)
+        np.testing.assert_allclose(out["scores"].sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_linear_regression_recovers_coeffs(self, rng):
+        x = rng.normal(size=(100, 2))
+        y = 3.0 * x[:, 0] - 2.0 * x[:, 1] + 0.5
+        t = Table({"features": x.astype(np.float32), "label": y})
+        model, out = fuzz(LinearRegression(), t)
+        np.testing.assert_allclose(model.weights["w"], [3.0, -2.0], atol=1e-3)
+        assert model.weights["b"][0] == pytest.approx(0.5, abs=1e-3)
+
+
+class TestTrainClassifier:
+    def test_auto_featurize_and_label_restore(self, rng):
+        n = 40
+        t = Table({
+            "x1": rng.normal(size=n),
+            "color": rng.choice(["red", "green"], size=n).tolist(),
+            "label": ["cat" if v > 0 else "dog" for v in rng.normal(size=n)],
+        })
+        model, out = fuzz(TrainClassifier(), t, rtol=1e-3)
+        assert set(out["prediction"]) <= {"cat", "dog"}
+
+    def test_learnable_signal(self, rng):
+        n = 100
+        x = rng.normal(size=n)
+        t = Table({"x": x, "label": (x > 0).astype(int)})
+        model = TrainClassifier(reindex_label=False).fit(t)
+        out = model.transform(t)
+        assert (np.asarray(out["prediction"]) == t["label"]).mean() > 0.9
+
+
+class TestTrainRegressor:
+    def test_mixed_inputs(self, rng):
+        n = 50
+        x = rng.normal(size=n)
+        cat = rng.choice(["a", "b"], size=n)
+        y = 2 * x + (cat == "a") * 3.0
+        t = Table({"x": x, "cat": cat.tolist(), "label": y})
+        model, out = fuzz(TrainRegressor(), t, rtol=1e-3)
+        resid = np.abs(np.asarray(out["prediction"]) - y)
+        assert resid.mean() < 0.1
+
+
+class TestStatistics:
+    def test_confusion_and_auc(self):
+        labels = np.array([0, 0, 1, 1])
+        preds = np.array([0, 1, 1, 1])
+        cm = confusion_matrix(labels, preds, 2)
+        assert cm.tolist() == [[1, 1], [0, 2]]
+        auc = roc_auc(labels, np.array([0.1, 0.4, 0.35, 0.8]))
+        assert auc == pytest.approx(0.75)
+
+    def test_classification_stats(self):
+        t = Table({
+            "label": np.array([0, 0, 1, 1]),
+            "prediction": np.array([0.0, 1.0, 1.0, 1.0]),
+            "scores": np.array([[0.9, 0.1], [0.4, 0.6], [0.3, 0.7], [0.1, 0.9]]),
+        })
+        out = ComputeModelStatistics(evaluation_metric="classification").transform(t)
+        assert out["accuracy"][0] == pytest.approx(0.75)
+        assert out["AUC"][0] == pytest.approx(1.0)
+
+    def test_regression_stats(self):
+        t = Table({"label": np.array([1.0, 2.0, 3.0]),
+                   "prediction": np.array([1.1, 1.9, 3.2])})
+        out = ComputeModelStatistics(evaluation_metric="regression").transform(t)
+        assert out["rmse"][0] == pytest.approx(np.sqrt(np.mean([0.01, 0.01, 0.04])))
+        assert out["r2"][0] > 0.95
+
+    def test_auto_mode_detects(self):
+        t = Table({"label": np.array([0.0, 1.0]), "prediction": np.array([0.0, 1.0])})
+        out = ComputeModelStatistics().transform(t)
+        assert "accuracy" in out
+
+    def test_per_instance(self):
+        t = Table({
+            "label": np.array([0, 1]),
+            "prediction": np.array([0.0, 1.0]),
+            "scores": np.array([[0.8, 0.2], [0.3, 0.7]]),
+        })
+        out = ComputePerInstanceStatistics(
+            evaluation_metric="classification"
+        ).transform(t)
+        assert out["log_loss"][0] == pytest.approx(-np.log(0.8))
+        out2 = ComputePerInstanceStatistics().transform(
+            Table({"label": np.array([1.0]), "prediction": np.array([1.5])})
+        )
+        assert out2["L2_loss"][0] == pytest.approx(0.25)
