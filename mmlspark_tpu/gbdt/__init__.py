@@ -1,0 +1,47 @@
+"""TPU-native histogram gradient-boosted decision trees.
+
+Capability parity with the reference's LightGBM module (lightgbm/, ~4.4k LoC
+Scala over the SWIG'd C++ engine) redesigned TPU-first:
+
+  - features are quantile-binned once (`BinMapper`) into uint8 codes;
+  - per-iteration gradients/hessians and per-leaf histograms are jitted XLA
+    programs (`segment_sum` scatter-adds that XLA lowers to efficient TPU
+    reductions) instead of the reference's C++ histogram kernels
+    (reference lightgbm/booster + LGBM_BoosterUpdateOneIter);
+  - distributed data-parallel training shards rows over a `jax.sharding.Mesh`
+    axis and `psum`s histograms over ICI — replacing the reference's
+    driver-socket rendezvous + native TCP ring AllReduce
+    (LightGBMBase.scala:392-430, TrainUtils.scala:279-295, LGBM_NetworkInit);
+  - voting-parallel mode reduces collective volume by pre-selecting top-k
+    features per shard (params/LightGBMParams.scala:16-21).
+"""
+from .binning import BinMapper
+from .boosting import Booster, TrainConfig
+from .estimators import (
+    GBDTClassificationModel,
+    GBDTClassifier,
+    GBDTRanker,
+    GBDTRankerModel,
+    GBDTRegressionModel,
+    GBDTRegressor,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRegressor,
+)
+from .tree import Tree
+
+__all__ = [
+    "BinMapper",
+    "Booster",
+    "TrainConfig",
+    "Tree",
+    "GBDTClassifier",
+    "GBDTClassificationModel",
+    "GBDTRegressor",
+    "GBDTRegressionModel",
+    "GBDTRanker",
+    "GBDTRankerModel",
+    "LightGBMClassifier",
+    "LightGBMRegressor",
+    "LightGBMRanker",
+]
