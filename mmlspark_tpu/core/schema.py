@@ -20,9 +20,18 @@ __all__ = [
     "Table",
     "CategoricalMap",
     "find_unused_column_name",
+    "features_matrix",
     "IMAGE_FIELDS",
     "is_image_column",
 ]
+
+
+def features_matrix(col: np.ndarray, dtype=np.float32) -> np.ndarray:
+    """Densify a features column to an (N, D) matrix: typed 2-D columns pass
+    through, object columns of per-row vectors are stacked."""
+    if col.dtype == object:
+        return np.stack([np.asarray(v, dtype=dtype) for v in col])
+    return np.asarray(col, dtype=dtype)
 
 # Spark-style image row: struct<origin,height,width,nChannels,mode,data>
 # (reference org/apache/spark/ml/source/image schema; ImageSchemaUtils.scala:9).

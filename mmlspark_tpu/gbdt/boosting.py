@@ -243,6 +243,11 @@ class Booster:
         w = np.ones(n) if sample_weight is None else np.asarray(sample_weight, np.float64)
         rng = np.random.default_rng(cfg.seed)
 
+        if self.bin_mapper is None and init_model is not None \
+                and init_model.bin_mapper is not None:
+            # warm start inherits the bin boundaries/categorical codes so
+            # inherited trees' threshold_bin stay valid on this data
+            self.bin_mapper = init_model.bin_mapper
         if self.bin_mapper is None:
             self.bin_mapper = BinMapper(cfg.max_bin,
                                         categorical_features=cfg.categorical_features,
@@ -277,6 +282,11 @@ class Booster:
         is_goss = cfg.boosting_type == "goss"
         shrinkage = 1.0 if is_rf else cfg.learning_rate
         rf_sum = np.zeros((n, c))
+        if is_rf and init_model is not None and init_model.trees:
+            # seed the running sum with inherited trees so 1/T renormalization
+            # counts them (bin mapper is shared by the warm-start adoption above)
+            for i, tree in enumerate(self.trees):
+                rf_sum[:, i % c] += tree.predict_binned(binned)
 
         # eval sets: (name, x, y[, group]) tuples; default = train set.
         # Raw eval scores are maintained incrementally (gbdt/goss) to avoid
@@ -286,14 +296,15 @@ class Booster:
             sets = list(eval_set) if eval_set else [("train", x, y) +
                                                     ((group,) if is_rank else ())]
             for entry in sets:
-                name, ex, ey = entry[0], np.asarray(entry[1], np.float64), \
+                name, ex_raw, ey = entry[0], np.asarray(entry[1], np.float64), \
                     np.asarray(entry[2], np.float64)
                 eg = np.asarray(entry[3]) if len(entry) > 3 else None
-                ex = self._prepare_x(ex)
                 if init_model is not None and init_model.trees:
-                    eraw = init_model._raw_scores(ex).reshape(len(ex), -1).copy()
+                    # _raw_scores encodes categoricals itself: feed raw rows
+                    eraw = init_model._raw_scores(ex_raw).reshape(len(ex_raw), -1).copy()
                 else:
-                    eraw = np.tile(self.init_score.reshape(1, -1), (len(ex), 1))
+                    eraw = np.tile(self.init_score.reshape(1, -1), (len(ex_raw), 1))
+                ex = self._prepare_x(ex_raw)
                 eval_state.append((name, ex, ey, eg, eraw))
 
         best_metric = np.inf

@@ -17,7 +17,7 @@ import numpy as np
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
-from ..core.schema import Table
+from ..core.schema import Table, features_matrix
 from .boosting import Booster, TrainConfig
 
 __all__ = [
@@ -29,9 +29,7 @@ __all__ = [
 
 
 def _features_matrix(col: np.ndarray) -> np.ndarray:
-    if col.dtype == object:
-        return np.stack([np.asarray(v, dtype=np.float64) for v in col])
-    return np.asarray(col, dtype=np.float64)
+    return features_matrix(col, dtype=np.float64)
 
 
 class _GBDTParams:
@@ -121,9 +119,25 @@ class _GBDTParams:
                 w = w[~vmask]
         return x, y, w, eval_set
 
+    def _resolve_mesh(self):
+        """Default mesh for the distributed tree learners: all local devices
+        on the data axis (LightGBMParams.scala:16-21 `parallelism`); serial
+        mode and single-device hosts run unsharded."""
+        if self.parallelism not in ("data_parallel", "voting_parallel"):
+            return None
+        import jax
+
+        if len(jax.devices()) <= 1:
+            return None
+        from ..parallel.mesh import make_mesh
+
+        return make_mesh(data=len(jax.devices()))
+
     def _train_booster(self, cfg: TrainConfig, x, y, w, eval_set,
                        group=None, mesh=None) -> Booster:
         """Single fit or numBatches warm-start chain."""
+        if mesh is None:
+            mesh = self._resolve_mesh()
         nb = self.num_batches
         if nb and nb > 1:
             rng = np.random.default_rng(self.seed)

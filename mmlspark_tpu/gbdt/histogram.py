@@ -179,10 +179,12 @@ class HistogramBuilder:
                 binned, NamedSharding(mesh, P(axis, None))
             )
             self._sharded_fn = self._make_sharded(mesh, axis)
+            self._sharded_local_fn = self._make_sharded_local(mesh, axis)
         else:
             self._pad = 0
             self.binned = jax.device_put(np.ascontiguousarray(binned))
             self._sharded_fn = None
+            self._sharded_local_fn = None
 
     def _pad_rows(self, arr, fill=0.0):
         if self._pad:
@@ -241,6 +243,9 @@ class HistogramBuilder:
         if self.mesh is None:
             h = build_histogram(self.binned, grad, hess, weight, mask, self.num_bins)
             return h[None]
+        return self._sharded_local_fn(self.binned, grad, hess, weight, mask)
+
+    def _make_sharded_local(self, mesh, axis):
         from jax.sharding import PartitionSpec as P
         from jax import shard_map
 
@@ -251,11 +256,11 @@ class HistogramBuilder:
 
         fn = shard_map(
             local_hist,
-            mesh=self.mesh,
-            in_specs=(P(self.axis, None), P(self.axis), P(self.axis), P(self.axis), P(self.axis)),
-            out_specs=P(self.axis),
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
         )
-        return jax.jit(fn)(self.binned, grad, hess, weight, mask)
+        return jax.jit(fn)
 
 
 def vote_features(

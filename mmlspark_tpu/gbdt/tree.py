@@ -77,7 +77,7 @@ class Tree:
 
     def predict_raw(self, x: np.ndarray) -> np.ndarray:
         """Vectorized traversal on raw float features (inference path);
-        NaN routes left iff the missing bin (0) is <= threshold_bin."""
+        NaN routes left (missing bin 0 satisfies every threshold)."""
         n = len(x)
         node = np.zeros(n, np.int32)
         for _ in range(max(self.max_depth, 1)):
@@ -86,8 +86,9 @@ class Tree:
             if not internal.any():
                 break
             fx = x[np.arange(n), np.maximum(f, 0)]
-            missing_left = self.threshold_bin[node] >= 0  # missing bin is 0
-            go_left = np.where(np.isnan(fx), missing_left, fx <= self.threshold_value[node])
+            # NaN routes left: the missing bin is 0, which every threshold_bin
+            # satisfies (same rule as predict_leaf_index / predict_forest)
+            go_left = np.where(np.isnan(fx), True, fx <= self.threshold_value[node])
             nxt = np.where(go_left, self.left[node], self.right[node])
             node = np.where(internal, nxt, node)
         return self.value[node]

@@ -18,7 +18,7 @@ import optax
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
-from ..core.schema import Table
+from ..core.schema import Table, features_matrix as _features_matrix
 
 __all__ = [
     "LogisticRegression",
@@ -28,10 +28,6 @@ __all__ = [
 ]
 
 
-def _features_matrix(col: np.ndarray) -> np.ndarray:
-    if col.dtype == object:
-        return np.stack([np.asarray(v, dtype=np.float32) for v in col])
-    return np.asarray(col, dtype=np.float32)
 
 
 class _GDMixin:

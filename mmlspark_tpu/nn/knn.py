@@ -1,0 +1,183 @@
+"""KNN estimators: batched maximum-inner-product search on the MXU.
+
+Reference: core nn/KNN.scala:48-126 (KNN broadcasts a BallTree, maps rows to
+`findMaximumInnerProducts(q, k)`) and nn/ConditionalKNN.scala:31-120 (adds a
+per-query set of allowed labels).  TPU-first redesign: bulk transform is a
+dense `Q @ K^T` scored on the MXU + `lax.top_k` — a batched matmul saturates
+the systolic array where the reference's per-row tree walk was pointer-bound;
+the serialized BallTree (nn/ball_tree.py) remains the single-query host path
+for serving.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.registry import register_stage
+from ..core.schema import Table, features_matrix as _matrix
+from .ball_tree import BallTree, ConditionalBallTree
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]
+
+_CHUNK = 4096  # query rows per device batch (bounds the (B, N) score matrix)
+
+
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_scores(keys: jnp.ndarray, queries: jnp.ndarray, k: int):
+    scores = queries @ keys.T  # (B, N) on the MXU
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_scores_masked(keys, queries, mask, k: int):
+    scores = queries @ keys.T
+    scores = jnp.where(mask, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def _batched_topk(keys_np, queries_np, k, mask_np=None):
+    """Chunked device top-k; returns (values (B,k), indices (B,k)) numpy."""
+    keys = jnp.asarray(keys_np)
+    vals, idxs = [], []
+    for lo in range(0, len(queries_np), _CHUNK):
+        q = jnp.asarray(queries_np[lo: lo + _CHUNK])
+        if mask_np is None:
+            v, i = _topk_scores(keys, q, k)
+        else:
+            m = jnp.asarray(mask_np[lo: lo + _CHUNK])
+            v, i = _topk_scores_masked(keys, q, m, k)
+        vals.append(np.asarray(v))
+        idxs.append(np.asarray(i))
+    return np.concatenate(vals), np.concatenate(idxs)
+
+
+class _KNNParams:
+    features_col = Param("query features column", default="features")
+    values_col = Param("payload column returned with each match", default="values")
+    output_col = Param("output column of matches", default="output")
+    k = Param("number of matches", default=5, converter=TypeConverters.to_int)
+    leaf_size = Param("ball-tree leaf size", default=50, converter=TypeConverters.to_int)
+
+
+@register_stage
+class KNN(Estimator, _KNNParams):
+    """Fit memorizes the (features, values) index table; transform scores
+    queries against it (KNN.scala:48)."""
+
+    def _fit(self, table: Table) -> "KNNModel":
+        keys = _matrix(table[self.features_col])
+        values = list(table[self.values_col])
+        return KNNModel(
+            features_col=self.features_col,
+            output_col=self.output_col,
+            k=self.k,
+            ball_tree=BallTree(keys, values, leaf_size=self.leaf_size),
+        )
+
+
+@register_stage
+class KNNModel(Model):
+    features_col = Param("query features column", default="features")
+    output_col = Param("output column of matches", default="output")
+    k = Param("number of matches", default=5, converter=TypeConverters.to_int)
+    ball_tree = ComplexParam("fitted BallTree (host single-query path)")
+
+    def _transform(self, table: Table) -> Table:
+        tree: BallTree = self.ball_tree
+        queries = _matrix(table[self.features_col])
+        k = min(self.k, len(tree))
+        vals, idxs = _batched_topk(tree.keys.astype(np.float32), queries, k)
+        out = np.empty(len(queries), dtype=object)
+        for r in range(len(queries)):
+            out[r] = [
+                {"value": tree.values[int(i)], "distance": float(v)}
+                for v, i in zip(vals[r], idxs[r])
+            ]
+        return table.with_column(self.output_col, out)
+
+    def query_one(self, point: np.ndarray, k: Optional[int] = None):
+        """Single-query host path via the ball tree (serving latency path)."""
+        return self.ball_tree.find_maximum_inner_products(point, k or self.k)
+
+    def transform_schema(self, columns: List[str]) -> List[str]:
+        if self.features_col not in columns:
+            raise ValueError(f"missing features column '{self.features_col}'")
+        return columns + [self.output_col]
+
+
+@register_stage
+class ConditionalKNN(Estimator, _KNNParams):
+    """KNN whose index rows carry labels and whose queries carry the set of
+    labels they may match (ConditionalKNN.scala:31)."""
+
+    label_col = Param("index label column", default="labels")
+
+    def _fit(self, table: Table) -> "ConditionalKNNModel":
+        keys = _matrix(table[self.features_col])
+        values = list(table[self.values_col])
+        labels = list(table[self.label_col])
+        return ConditionalKNNModel(
+            features_col=self.features_col,
+            output_col=self.output_col,
+            conditioner_col=self.conditioner_col,
+            k=self.k,
+            ball_tree=ConditionalBallTree(keys, values, labels, leaf_size=self.leaf_size),
+        )
+
+    conditioner_col = Param("query column holding the allowed-label set", default="conditioner")
+
+
+@register_stage
+class ConditionalKNNModel(Model):
+    features_col = Param("query features column", default="features")
+    output_col = Param("output column of matches", default="output")
+    conditioner_col = Param("query column holding the allowed-label set", default="conditioner")
+    k = Param("number of matches", default=5, converter=TypeConverters.to_int)
+    ball_tree = ComplexParam("fitted ConditionalBallTree")
+
+    def _transform(self, table: Table) -> Table:
+        tree: ConditionalBallTree = self.ball_tree
+        queries = _matrix(table[self.features_col])
+        conditioners = table[self.conditioner_col]
+        k = min(self.k, len(tree))
+        # vectorized label filter: code labels to ints once, build (B, N) mask
+        levels = {v: i for i, v in enumerate(dict.fromkeys(tree.labels.tolist()))}
+        codes = np.array([levels[v] for v in tree.labels.tolist()], dtype=np.int32)
+        mask = np.zeros((len(queries), len(tree)), dtype=bool)
+        for r, cond in enumerate(conditioners):
+            allowed = {levels[c] for c in cond if c in levels}
+            if allowed:
+                mask[r] = np.isin(codes, list(allowed))
+        vals, idxs = _batched_topk(tree.keys.astype(np.float32), queries, k, mask)
+        out = np.empty(len(queries), dtype=object)
+        for r in range(len(queries)):
+            matches = []
+            for v, i in zip(vals[r], idxs[r]):
+                if not np.isfinite(v):
+                    continue  # fewer than k items matched the conditioner
+                matches.append(
+                    {
+                        "value": tree.values[int(i)],
+                        "distance": float(v),
+                        "label": tree.labels[int(i)],
+                    }
+                )
+            out[r] = matches
+        return table.with_column(self.output_col, out)
+
+    def query_one(self, point: np.ndarray, allowed: set, k: Optional[int] = None):
+        return self.ball_tree.find_maximum_inner_products(point, k or self.k, allowed=allowed)
+
+    def transform_schema(self, columns: List[str]) -> List[str]:
+        for c in (self.features_col, self.conditioner_col):
+            if c not in columns:
+                raise ValueError(f"missing column '{c}'")
+        return columns + [self.output_col]

@@ -1,0 +1,132 @@
+"""nn package tests: ball-tree correctness vs brute force, KNN estimators,
+conditional filtering, serialization fuzzing.
+
+Mirrors reference core/src/test/.../nn/BallTreeTest.scala + KNNSuite.scala.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.nn import (
+    KNN,
+    BallTree,
+    ConditionalBallTree,
+    ConditionalKNN,
+)
+from fuzzing import fuzz_estimator
+
+
+def brute_topk(keys, q, k):
+    ips = keys @ q
+    order = np.argsort(-ips, kind="stable")[:k]
+    return [(int(i), float(ips[i])) for i in order]
+
+
+class TestBallTree:
+    def test_matches_brute_force(self, rng):
+        keys = rng.normal(size=(500, 16))
+        tree = BallTree(keys, leaf_size=10)
+        for _ in range(20):
+            q = rng.normal(size=16)
+            got = tree.find_maximum_inner_products(q, k=7)
+            want = brute_topk(keys, q, 7)
+            assert [m.index for m in got] == [i for i, _ in want] or np.allclose(
+                [m.distance for m in got], [d for _, d in want]
+            )
+
+    def test_payload_values(self, rng):
+        keys = rng.normal(size=(50, 4))
+        values = [f"item{i}" for i in range(50)]
+        tree = BallTree(keys, values)
+        m = tree.find_maximum_inner_products(keys[13], k=1)[0]
+        # the query point itself need not be the argmax under inner product,
+        # but the payload must match the returned index
+        assert m.value == f"item{m.index}"
+
+    def test_duplicate_points(self):
+        keys = np.ones((20, 3))
+        tree = BallTree(keys, leaf_size=4)
+        got = tree.find_maximum_inner_products(np.ones(3), k=5)
+        assert len(got) == 5
+        assert all(abs(m.distance - 3.0) < 1e-9 for m in got)
+
+    def test_conditional_filters_labels(self, rng):
+        keys = rng.normal(size=(200, 8))
+        labels = [("even" if i % 2 == 0 else "odd") for i in range(200)]
+        tree = ConditionalBallTree(keys, labels=labels, leaf_size=16)
+        q = rng.normal(size=8)
+        got = tree.find_maximum_inner_products(q, k=10, allowed={"even"})
+        assert len(got) == 10
+        assert all(m.index % 2 == 0 for m in got)
+        # equals brute force restricted to evens
+        evens = np.arange(0, 200, 2)
+        ips = keys[evens] @ q
+        best = evens[np.argmax(ips)]
+        assert got[0].index == best
+
+
+class TestKNN:
+    def _index_table(self, rng, n=100, d=8):
+        return Table(
+            {
+                "features": rng.normal(size=(n, d)).astype(np.float32),
+                "values": [f"v{i}" for i in range(n)],
+                "labels": [("a" if i % 3 == 0 else "b") for i in range(n)],
+            }
+        )
+
+    def test_knn_fit_transform(self, rng):
+        index = self._index_table(rng)
+        knn = KNN(k=3)
+        model = knn.fit(index)
+        queries = Table({"features": rng.normal(size=(10, 8)).astype(np.float32)})
+        out = model.transform(queries)
+        matches = out["output"]
+        assert len(matches) == 10
+        keys = np.stack([np.asarray(v) for v in index["features"]]) if index[
+            "features"
+        ].dtype == object else np.asarray(index["features"])
+        for r in range(10):
+            assert len(matches[r]) == 3
+            q = np.asarray(queries["features"][r], dtype=np.float64)
+            want = brute_topk(keys.astype(np.float64), q, 3)
+            got_vals = [m["distance"] for m in matches[r]]
+            assert np.allclose(got_vals, [d for _, d in want], rtol=1e-4)
+
+    def test_device_and_host_paths_agree(self, rng):
+        index = self._index_table(rng)
+        model = KNN(k=4).fit(index)
+        q = rng.normal(size=8).astype(np.float32)
+        host = model.query_one(q)
+        dev = model.transform(Table({"features": q[None, :]}))["output"][0]
+        assert [m.value for m in host] == [m["value"] for m in dev]
+
+    def test_conditional_knn(self, rng):
+        index = self._index_table(rng)
+        model = ConditionalKNN(k=5, label_col="labels").fit(index)
+        queries = Table(
+            {
+                "features": rng.normal(size=(6, 8)).astype(np.float32),
+                "conditioner": [{"a"}, {"b"}, {"a", "b"}, {"a"}, {"b"}, {"missing"}],
+            }
+        )
+        out = model.transform(queries)["output"]
+        for r, cond in enumerate(queries["conditioner"]):
+            for m in out[r]:
+                assert m["label"] in cond
+        assert out[5] == []  # no items carry label 'missing'
+
+    def test_fuzz_knn(self, rng):
+        index = self._index_table(rng)
+        fuzz_estimator(KNN(k=2), index, rtol=1e-3)
+
+    def test_fuzz_conditional_knn(self, rng):
+        t = Table(
+            {
+                "features": rng.normal(size=(30, 4)).astype(np.float32),
+                "values": list(range(30)),
+                "labels": ["x"] * 15 + ["y"] * 15,
+                "conditioner": [{"x", "y"}] * 30,
+            }
+        )
+        fuzz_estimator(ConditionalKNN(k=2, label_col="labels"), t, rtol=1e-3)
