@@ -1,0 +1,214 @@
+"""Local explainer framework: LIME + KernelSHAP bases.
+
+Reference: core explainers/LocalExplainer.scala:16, LIMEBase.scala:49-145,
+KernelSHAPBase.scala:36-138, Sampler.scala, KernelSHAPSampler.scala.
+
+TPU-first architecture: the reference samples per-row, scores through the model,
+then solves a per-row Breeze regression inside `groupByKey.mapGroups`.  Here all
+rows' perturbation samples are materialized into ONE samples Table so the wrapped
+model runs a single large batched transform (MXU-friendly), and every per-row /
+per-target regression is solved in one vmapped jit call
+(regression.batch_lasso / batch_weighted_least_squares).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+from ..core.schema import Table, find_unused_column_name
+from .regression import (
+    batch_lasso,
+    batch_weighted_least_squares,
+    np_batch_weighted_least_squares,
+)
+
+__all__ = ["LocalExplainer", "LIMEBase", "KernelSHAPBase"]
+
+
+class LocalExplainer(Transformer):
+    """Common contract: wrap a fitted model, add a column of local importances.
+
+    The output column holds, per input row, a (num_targets, dim) float array —
+    `dim` = number of interpretable features (columns / superpixels / tokens).
+    """
+
+    model = ComplexParam("the model to explain (a fitted Transformer)")
+    target_col = Param("model output column with scores", default="scores")
+    target_classes = Param("class indices to explain", default=None,
+                           converter=TypeConverters.to_list_int)
+    output_col = Param("explanation output column", default="explanation")
+    num_samples = Param("perturbation samples per row", default=128,
+                        converter=TypeConverters.to_int)
+    seed = Param("sampling seed", default=0, converter=TypeConverters.to_int)
+
+    # ---- subclass surface -------------------------------------------------
+    def _build_samples(self, table: Table) -> Tuple[Table, np.ndarray]:
+        """Return (samples_table, states) where samples_table stacks
+        num_samples perturbed copies of every row (row-major: all samples of
+        row 0, then row 1, ...) and states is the binary/continuous design
+        (n_rows, num_samples, dim)."""
+        raise NotImplementedError
+
+    def _sample_weights(self, states: np.ndarray) -> np.ndarray:
+        """(n_rows, num_samples) regression weights for the design."""
+        raise NotImplementedError
+
+    def _solve(self, states, weights, targets):
+        """(coefs (n, t, d), intercepts (n, t)) from the scored samples."""
+        raise NotImplementedError
+
+    # ---- shared driver ----------------------------------------------------
+    def _target_scores(self, scored: Table) -> np.ndarray:
+        """Extract (n_samples_total, n_targets) from the model output column."""
+        col = scored[self.target_col]
+        if col.dtype == object:
+            mat = np.stack([np.atleast_1d(np.asarray(v, np.float32)) for v in col])
+        else:
+            mat = np.asarray(col, np.float32)
+            if mat.ndim == 1:
+                mat = mat[:, None]
+        classes = self.get_or_default("target_classes")
+        if classes:
+            mat = mat[:, np.asarray(classes, int)]
+        return mat
+
+    def _transform(self, table: Table) -> Table:
+        model: Transformer = self.model
+        n = len(table)
+        s = int(self.num_samples)
+        samples, states = self._build_samples(table)
+        scored = model.transform(samples)
+        targets = self._target_scores(scored)  # (n*s, t)
+        t = targets.shape[1]
+        targets = targets.reshape(n, s, t)
+        weights = self._sample_weights(states)
+        coefs, intercepts = self._solve(
+            np.asarray(states, np.float32), np.asarray(weights, np.float32), targets
+        )
+        coefs = np.asarray(coefs)  # (n, t, d)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = coefs[i]
+        out_col = self.get_or_default("output_col") or find_unused_column_name(
+            "explanation", table.column_names
+        )
+        result = table.with_column(out_col, out)
+        if getattr(self, "_emit_r2", False):
+            r2 = self._fit_r2(states, weights, targets, coefs, np.asarray(intercepts))
+            result = result.with_column(out_col + "_r2", r2)
+        return result
+
+    _emit_r2 = False
+
+    @staticmethod
+    def _fit_r2(states, weights, targets, coefs, intercepts) -> np.ndarray:
+        """Goodness-of-fit of the surrogate per (row, target): (n, t) array."""
+        n, s, d = states.shape
+        preds = np.einsum("nsd,ntd->nst", states, coefs) + intercepts[:, None, :]
+        w = weights / (weights.sum(axis=1, keepdims=True) + 1e-12)
+        ybar = np.einsum("ns,nst->nt", w, targets)[:, None, :]
+        ss_res = np.einsum("ns,nst->nt", w, (targets - preds) ** 2)
+        ss_tot = np.einsum("ns,nst->nt", w, (targets - ybar) ** 2)
+        return 1.0 - ss_res / (ss_tot + 1e-12)
+
+
+class LIMEBase(LocalExplainer):
+    """LIME: locally-weighted sparse linear surrogate.
+
+    Reference: explainers/LIMEBase.scala:49-145 — sample, score, exponential
+    kernel weights over sample distance, per-row weighted lasso.
+    """
+
+    kernel_width = Param("exponential kernel width", default=0.75,
+                         converter=TypeConverters.to_float)
+    regularization = Param("lasso l1 strength (0 -> plain WLS)", default=0.0,
+                           converter=TypeConverters.to_float)
+    _emit_r2 = True
+
+    #: set by ragged subclasses (image/text) to each row's true feature count,
+    #: so padded design columns never leak into the kernel weights
+    _true_dims = None
+
+    def _distances(self, states: np.ndarray) -> np.ndarray:
+        """Default: fraction of dropped interpretable features relative to the
+        all-ones (original) state; continuous subclasses override."""
+        dims = self._true_dims
+        if dims is None:
+            return 1.0 - states.mean(axis=-1)
+        out = np.empty(states.shape[:2], np.float32)
+        for i, k in enumerate(dims):
+            out[i] = 1.0 - states[i, :, :k].mean(axis=-1)
+        return out
+
+    def _sample_weights(self, states: np.ndarray) -> np.ndarray:
+        dist = self._distances(states)
+        kw = float(self.kernel_width)
+        return np.exp(-(dist ** 2) / (kw ** 2)).astype(np.float32)
+
+    def _solve(self, states, weights, targets):
+        alpha = float(self.regularization)
+        if alpha > 0:
+            return batch_lasso(states, targets, weights, alpha)
+        return batch_weighted_least_squares(states, targets, weights)
+
+
+def shapley_kernel_weights(num_on: np.ndarray, dim: int) -> np.ndarray:
+    """KernelSHAP weight pi(z) = (M-1) / (C(M,|z|) |z| (M-|z|)); the full and
+    null coalitions get a large finite weight (reference treats them as
+    constraints — KernelSHAPBase.scala:36-138)."""
+    from math import comb
+
+    m = dim
+    k = np.asarray(num_on, int)
+    w = np.zeros(k.shape, np.float64)
+    interior = (k > 0) & (k < m)
+    kk = k[interior]
+    w[interior] = (m - 1) / (
+        np.array([comb(m, int(x)) for x in kk], np.float64) * kk * (m - kk)
+    )
+    # anchor coalitions: weight far above any interior weight
+    w[~interior] = (w[interior].max() if interior.any() else 1.0) * 1e6
+    return w.astype(np.float32)
+
+
+class KernelSHAPBase(LocalExplainer):
+    """KernelSHAP: Shapley values by weighted least squares over coalitions.
+
+    Reference: explainers/KernelSHAPBase.scala:36-138, KernelSHAPSampler.scala.
+    Coalition sampling: always include the null and full coalitions, then draw
+    subsets with P(|z|) proportional to the Shapley kernel mass of size |z|.
+    """
+
+    _emit_r2 = True
+
+    def _coalitions(self, dim: int, rng: np.random.Generator) -> np.ndarray:
+        """(num_samples, dim) binary coalition matrix."""
+        s = int(self.num_samples)
+        out = np.zeros((s, dim), np.float32)
+        out[0] = 1.0  # full
+        # out[1] stays null
+        if dim <= 1:
+            return out
+        from math import comb
+
+        sizes = np.arange(1, dim)
+        mass = (dim - 1) / (sizes * (dim - sizes))
+        mass = mass / mass.sum()
+        counts = rng.choice(sizes, size=max(s - 2, 0), p=mass)
+        for i, c in enumerate(counts):
+            idx = rng.choice(dim, size=int(c), replace=False)
+            out[i + 2, idx] = 1.0
+        return out
+
+    def _sample_weights(self, states: np.ndarray) -> np.ndarray:
+        dim = states.shape[-1]
+        num_on = states.sum(axis=-1)
+        return np.stack([shapley_kernel_weights(row, dim) for row in num_on])
+
+    def _solve(self, states, weights, targets):
+        # float64 host solve: the 1e6 anchor weights on the full/null
+        # coalitions are beyond f32 dynamic range (see regression.py).
+        return np_batch_weighted_least_squares(states, targets, weights)

@@ -45,6 +45,8 @@ def _topk_scores_masked(keys, queries, mask, k: int):
 
 def _batched_topk(keys_np, queries_np, k, mask_np=None):
     """Chunked device top-k; returns (values (B,k), indices (B,k)) numpy."""
+    if len(queries_np) == 0:
+        return (np.zeros((0, k), np.float32), np.zeros((0, k), np.int32))
     keys = jnp.asarray(keys_np)
     vals, idxs = [], []
     for lo in range(0, len(queries_np), _CHUNK):

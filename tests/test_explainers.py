@@ -1,0 +1,234 @@
+"""Explainer suite — reference: explainers/split1/*Explainer*Suite.scala
+(recovering known linear weights; SHAP additivity; superpixel/token locality).
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import LambdaTransformer, Table
+from mmlspark_tpu.explainers import (
+    ImageLIME,
+    ImageSHAP,
+    SuperpixelTransformer,
+    TabularLIME,
+    TabularSHAP,
+    TextLIME,
+    TextSHAP,
+    VectorLIME,
+    VectorSHAP,
+    slic_segments,
+    weighted_least_squares,
+    lasso,
+)
+
+W = np.array([2.0, -3.0, 0.5], np.float32)
+
+
+def _linear_fn(t):
+    from mmlspark_tpu.core.schema import features_matrix
+
+    x = features_matrix(t["features"])
+    return t.with_column("scores", x @ W)
+
+
+def linear_model():
+    """scores = X @ W (one target)."""
+    return LambdaTransformer(_linear_fn)
+
+
+def test_wls_recovers_linear():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    y = X @ W + 1.5
+    coefs, intercept = weighted_least_squares(X, y, np.ones(200, np.float32))
+    np.testing.assert_allclose(np.asarray(coefs), W, atol=1e-3)
+    assert abs(float(intercept) - 1.5) < 1e-3
+
+
+def test_lasso_sparsity():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(300, 8)).astype(np.float32)
+    w_true = np.zeros(8, np.float32)
+    w_true[0], w_true[3] = 3.0, -2.0
+    y = X @ w_true
+    coefs, _ = lasso(X, y, np.ones(300, np.float32), alpha=0.05)
+    coefs = np.asarray(coefs)
+    assert abs(coefs[0] - 3.0) < 0.2 and abs(coefs[3] + 2.0) < 0.2
+    dead = np.delete(coefs, [0, 3])
+    assert np.all(np.abs(dead) < 0.1)
+
+
+@pytest.fixture
+def tab():
+    rng = np.random.default_rng(2)
+    return Table({"features": rng.normal(size=(5, 3)).astype(np.float32)})
+
+
+def test_tabular_lime_recovers_weights(tab):
+    exp = TabularLIME(
+        model=linear_model(), input_cols=None, num_samples=256, seed=3,
+        target_col="scores",
+    )
+    out = exp.transform(tab)
+    for row in out["explanation"]:
+        np.testing.assert_allclose(np.asarray(row)[0], W, atol=0.05)
+    r2 = np.stack([np.asarray(v) for v in out["explanation_r2"]])
+    assert np.all(r2 > 0.99)
+
+
+def test_vector_lime_lasso(tab):
+    exp = VectorLIME(
+        model=linear_model(), num_samples=256, seed=4, regularization=0.01,
+    )
+    out = exp.transform(tab)
+    coefs = np.asarray(out["explanation"][0])[0]
+    # lasso shrinks but ordering of |w| is preserved
+    assert abs(coefs[1]) > abs(coefs[0]) > abs(coefs[2])
+
+
+def test_tabular_shap_additivity(tab):
+    exp = TabularSHAP(model=linear_model(), num_samples=64, seed=5)
+    out = exp.transform(tab)
+    x = tab["features"]
+    mean = x.mean(axis=0)
+    for i, row in enumerate(out["explanation"]):
+        phi = np.asarray(row)[0]
+        # linear model: phi_j = w_j (x_j - E[x_j]); sum phi = f(x) - f(E[x])
+        np.testing.assert_allclose(phi, W * (x[i] - mean), atol=0.05)
+
+
+def test_tabular_shap_scalar_cols():
+    rng = np.random.default_rng(6)
+    t = Table({
+        "a": rng.normal(size=8).astype(np.float32),
+        "b": rng.normal(size=8).astype(np.float32),
+        "c": rng.normal(size=8).astype(np.float32),
+    })
+
+    def fn(tbl):
+        s = 2.0 * tbl["a"] - 3.0 * tbl["b"] + 0.5 * tbl["c"]
+        return tbl.with_column("scores", s.astype(np.float32))
+
+    exp = TabularSHAP(model=LambdaTransformer(fn), input_cols=["a", "b", "c"],
+                      num_samples=64, seed=7)
+    out = exp.transform(t)
+    phi = np.asarray(out["explanation"][0])[0]
+    x0 = np.array([t["a"][0], t["b"][0], t["c"][0]])
+    mean = np.array([t["a"].mean(), t["b"].mean(), t["c"].mean()])
+    np.testing.assert_allclose(phi, W * (x0 - mean), atol=0.05)
+
+
+def test_vector_shap_multi_target(tab):
+    def fn(t):
+        from mmlspark_tpu.core.schema import features_matrix
+
+        x = features_matrix(t["features"])
+        scores = np.stack([x @ W, -(x @ W)], axis=1)
+        out = np.empty(len(t), dtype=object)
+        for i in range(len(t)):
+            out[i] = scores[i]
+        return t.with_column("scores", out)
+
+    exp = VectorSHAP(model=LambdaTransformer(fn), num_samples=64, seed=8,
+                     target_classes=[0, 1])
+    out = exp.transform(tab)
+    row = np.asarray(out["explanation"][0])
+    assert row.shape[0] == 2
+    np.testing.assert_allclose(row[0], -row[1], atol=1e-3)
+
+
+def test_slic_segments_shape():
+    rng = np.random.default_rng(9)
+    img = rng.random((32, 32, 3)).astype(np.float32)
+    labels = slic_segments(img, n_segments=9)
+    assert labels.shape == (32, 32)
+    assert labels.max() >= 3
+
+
+def test_superpixel_transformer_stage():
+    rng = np.random.default_rng(10)
+    imgs = np.empty(2, dtype=object)
+    for i in range(2):
+        imgs[i] = rng.random((24, 24, 3)).astype(np.float32)
+    t = Table({"image": imgs})
+    out = SuperpixelTransformer(input_col="image", output_col="sp").transform(t)
+    assert out["sp"][0].shape == (24, 24)
+
+
+def brightness_model():
+    """score = mean brightness of the left half of the image."""
+
+    def fn(t):
+        vals = np.array(
+            [float(np.asarray(img)[:, :16].mean()) for img in t["image"]],
+            np.float32,
+        )
+        return t.with_column("scores", vals)
+
+    return LambdaTransformer(fn)
+
+
+def _bright_left_image():
+    img = np.zeros((32, 32, 3), np.float32)
+    img[:, :16] = 1.0
+    return img
+
+
+def test_image_lime_locality():
+    imgs = np.empty(1, dtype=object)
+    imgs[0] = _bright_left_image()
+    t = Table({"image": imgs})
+    exp = ImageLIME(model=brightness_model(), num_samples=128, seed=11,
+                    cell_size=8.0)
+    out = exp.transform(t)
+    coefs = np.asarray(out["explanation"][0])[0]
+    labels = slic_segments(imgs[0], n_segments=(32 * 32) // 64)
+    # superpixels centered in the left half should dominate
+    left_ids = np.unique(labels[:, :12])
+    right_ids = np.setdiff1d(np.unique(labels[:, 20:]), left_ids)
+    assert coefs[left_ids].mean() > coefs[right_ids].mean() + 1e-4
+
+
+def test_image_shap_runs():
+    imgs = np.empty(1, dtype=object)
+    imgs[0] = _bright_left_image()
+    t = Table({"image": imgs})
+    out = ImageSHAP(model=brightness_model(), num_samples=64, seed=12,
+                    cell_size=8.0).transform(t)
+    assert np.asarray(out["explanation"][0]).ndim == 2
+
+
+def keyword_model():
+    def fn(t):
+        vals = np.array(
+            [1.0 if "magic" in str(s).split() else 0.0 for s in t["text"]],
+            np.float32,
+        )
+        return t.with_column("scores", vals)
+
+    return LambdaTransformer(fn)
+
+
+def test_text_lime_keyword():
+    t = Table({"text": ["the magic word appears here once", "no special token at all"]})
+    exp = TextLIME(model=keyword_model(), num_samples=128, seed=13)
+    out = exp.transform(t)
+    toks = out["tokens"][0]
+    coefs = np.asarray(out["explanation"][0])[0][: len(toks)]
+    assert toks[np.argmax(coefs)] == "magic"
+
+
+def test_text_shap_keyword():
+    t = Table({"text": ["alpha beta magic gamma"]})
+    out = TextSHAP(model=keyword_model(), num_samples=64, seed=14).transform(t)
+    toks = out["tokens"][0]
+    phi = np.asarray(out["explanation"][0])[0][: len(toks)]
+    assert toks[np.argmax(phi)] == "magic"
+    # additivity: sum phi ~= f(x) - f(null)
+    assert abs(phi.sum() - 1.0) < 0.15
+
+
+def test_explainer_roundtrip(tab):
+    from fuzzing import fuzz_transformer
+
+    exp = TabularLIME(model=linear_model(), num_samples=64, seed=15)
+    fuzz_transformer(exp, tab)
