@@ -25,7 +25,19 @@ from .regression import (
     np_batch_weighted_least_squares,
 )
 
-__all__ = ["LocalExplainer", "LIMEBase", "KernelSHAPBase"]
+__all__ = ["LocalExplainer", "LIMEBase", "KernelSHAPBase", "pad_ragged_states"]
+
+
+def pad_ragged_states(states: List[np.ndarray]) -> np.ndarray:
+    """Pad per-row (s, k_i) binary designs to (n, s, k_max).  Padded dims are
+    constant-on: weightless in the centered regressions, and excluded from
+    kernel weights via the subclass's `_true_dims`."""
+    kmax = max(st.shape[1] for st in states)
+    n, s = len(states), states[0].shape[0]
+    out = np.ones((n, s, kmax), np.float32)
+    for i, st in enumerate(states):
+        out[i, :, : st.shape[1]] = st
+    return out
 
 
 class LocalExplainer(Transformer):
@@ -156,21 +168,16 @@ class LIMEBase(LocalExplainer):
 
 
 def shapley_kernel_weights(num_on: np.ndarray, dim: int) -> np.ndarray:
-    """KernelSHAP weight pi(z) = (M-1) / (C(M,|z|) |z| (M-|z|)); the full and
-    null coalitions get a large finite weight (reference treats them as
+    """Regression weights given that coalitions were SAMPLED with
+    P(|z|) proportional to the Shapley kernel mass (KernelSHAPBase._coalitions):
+    interior coalitions get uniform weight (the sampling already encodes the
+    kernel — weighting again would square it), while the full and null
+    coalitions get a large anchor weight (the reference treats them as hard
     constraints — KernelSHAPBase.scala:36-138)."""
-    from math import comb
-
-    m = dim
     k = np.asarray(num_on, int)
-    w = np.zeros(k.shape, np.float64)
-    interior = (k > 0) & (k < m)
-    kk = k[interior]
-    w[interior] = (m - 1) / (
-        np.array([comb(m, int(x)) for x in kk], np.float64) * kk * (m - kk)
-    )
-    # anchor coalitions: weight far above any interior weight
-    w[~interior] = (w[interior].max() if interior.any() else 1.0) * 1e6
+    w = np.ones(k.shape, np.float64)
+    interior = (k > 0) & (k < dim)
+    w[~interior] = 1e6
     return w.astype(np.float32)
 
 
@@ -183,6 +190,8 @@ class KernelSHAPBase(LocalExplainer):
     """
 
     _emit_r2 = True
+    #: ragged subclasses (image/text) set this to each row's true dim
+    _true_dims = None
 
     def _coalitions(self, dim: int, rng: np.random.Generator) -> np.ndarray:
         """(num_samples, dim) binary coalition matrix."""
@@ -204,9 +213,14 @@ class KernelSHAPBase(LocalExplainer):
         return out
 
     def _sample_weights(self, states: np.ndarray) -> np.ndarray:
-        dim = states.shape[-1]
-        num_on = states.sum(axis=-1)
-        return np.stack([shapley_kernel_weights(row, dim) for row in num_on])
+        dims = self._true_dims
+        if dims is None:
+            dims = [states.shape[-1]] * states.shape[0]
+        out = []
+        for i, k in enumerate(dims):
+            num_on = states[i, :, :k].sum(axis=-1)
+            out.append(shapley_kernel_weights(num_on, k))
+        return np.stack(out)
 
     def _solve(self, states, weights, targets):
         # float64 host solve: the 1e6 anchor weights on the full/null

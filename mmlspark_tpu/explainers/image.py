@@ -15,8 +15,8 @@ import numpy as np
 from ..core.params import Param, TypeConverters
 from ..core.registry import register_stage
 from ..core.schema import Table
-from .base import KernelSHAPBase, LIMEBase
-from .superpixel import masked_image, slic_segments
+from .base import KernelSHAPBase, LIMEBase, pad_ragged_states
+from .superpixel import masked_image, segments_for_image
 
 __all__ = ["ImageLIME", "ImageSHAP"]
 
@@ -37,15 +37,10 @@ class _ImageSamplerMixin:
         sp_col = self.get_or_default("superpixel_col")
         if sp_col:
             return [np.asarray(v) for v in table[sp_col]]
-        out = []
-        for img in table[self.input_col]:
-            img = np.asarray(img)
-            n_seg = max((img.shape[0] * img.shape[1]) // int(self.cell_size) ** 2, 4)
-            out.append(
-                slic_segments(img, n_segments=n_seg,
-                              compactness=float(self.modifier) / 10.0)
-            )
-        return out
+        return [
+            segments_for_image(img, float(self.cell_size), float(self.modifier))
+            for img in table[self.input_col]
+        ]
 
     def _emit(self, table: Table, states_per_row: List[np.ndarray],
               segments: List[np.ndarray]) -> Table:
@@ -65,18 +60,6 @@ class _ImageSamplerMixin:
                 )
         out = table.take(np.repeat(np.arange(n), s))
         return out.with_column(self.input_col, sample_imgs)
-
-    @staticmethod
-    def _pad_states(states_per_row: List[np.ndarray]) -> np.ndarray:
-        """Pad ragged (s, k_i) designs to (n, s, k_max); padded dims are
-        constant-on (weightless in the regression)."""
-        kmax = max(st.shape[1] for st in states_per_row)
-        n = len(states_per_row)
-        s = states_per_row[0].shape[0]
-        out = np.ones((n, s, kmax), np.float32)
-        for i, st in enumerate(states_per_row):
-            out[i, :, : st.shape[1]] = st
-        return out
 
 
 @register_stage
@@ -100,7 +83,7 @@ class ImageLIME(LIMEBase, _ImageSamplerMixin):
             st[0] = 1.0  # unmasked instance
             states.append(st)
         samples = self._emit(table, states, segments)
-        return samples, self._pad_states(states)
+        return samples, pad_ragged_states(states)
 
 
 @register_stage
@@ -111,16 +94,7 @@ class ImageSHAP(KernelSHAPBase, _ImageSamplerMixin):
         rng = np.random.default_rng(int(self.seed))
         segments = self._segments(table)
         self._num_segments = [int(seg.max()) + 1 for seg in segments]
+        self._true_dims = self._num_segments
         states = [self._coalitions(k, rng) for k in self._num_segments]
         samples = self._emit(table, states, segments)
-        return samples, self._pad_states(states)
-
-    def _sample_weights(self, states: np.ndarray) -> np.ndarray:
-        # per-row true dim differs after padding; recompute per row
-        from .base import shapley_kernel_weights
-
-        out = []
-        for i, k in enumerate(self._num_segments):
-            num_on = states[i, :, :k].sum(axis=-1)
-            out.append(shapley_kernel_weights(num_on, k))
-        return np.stack(out)
+        return samples, pad_ragged_states(states)

@@ -19,7 +19,8 @@ from ..core.pipeline import Transformer
 from ..core.registry import register_stage
 from ..core.schema import Table, find_unused_column_name
 
-__all__ = ["slic_segments", "SuperpixelTransformer", "masked_image"]
+__all__ = ["slic_segments", "segments_for_image", "SuperpixelTransformer",
+           "masked_image"]
 
 
 def slic_segments(
@@ -93,6 +94,15 @@ def masked_image(
     return img * mask + background * (1.0 - mask)
 
 
+def segments_for_image(image: np.ndarray, cell_size: float,
+                       modifier: float) -> np.ndarray:
+    """The one cell_size/modifier -> SLIC argument mapping, shared by
+    SuperpixelTransformer and the image explainers."""
+    img = np.asarray(image)
+    n_seg = max((img.shape[0] * img.shape[1]) // int(cell_size) ** 2, 4)
+    return slic_segments(img, n_segments=n_seg, compactness=modifier / 10.0)
+
+
 @register_stage
 class SuperpixelTransformer(Transformer):
     """Adds a (H, W) superpixel label-map column for an image column.
@@ -119,8 +129,6 @@ class SuperpixelTransformer(Transformer):
         col = table[self.input_col]
         out = np.empty(len(table), dtype=object)
         for i in range(len(table)):
-            img = np.asarray(col[i])
-            n_seg = max((img.shape[0] * img.shape[1]) // int(self.cell_size) ** 2, 4)
-            out[i] = slic_segments(img, n_segments=n_seg,
-                                   compactness=self.modifier / 10.0)
+            out[i] = segments_for_image(col[i], float(self.cell_size),
+                                        float(self.modifier))
         return table.with_column(self._out_col(table), out)

@@ -12,7 +12,7 @@ import numpy as np
 from ..core.params import Param, TypeConverters
 from ..core.registry import register_stage
 from ..core.schema import Table
-from .base import KernelSHAPBase, LIMEBase
+from .base import KernelSHAPBase, LIMEBase, pad_ragged_states
 
 __all__ = ["TextLIME", "TextSHAP"]
 
@@ -38,15 +38,6 @@ class _TextSamplerMixin:
                 )
         out = table.take(np.repeat(np.arange(n), s))
         return out.with_column(self.input_col, texts)
-
-    @staticmethod
-    def _pad_states(states: List[np.ndarray]) -> np.ndarray:
-        kmax = max(st.shape[1] for st in states)
-        n, s = len(states), states[0].shape[0]
-        out = np.ones((n, s, kmax), np.float32)
-        for i, st in enumerate(states):
-            out[i, :, : st.shape[1]] = st
-        return out
 
     def _attach_tokens(self, result: Table, tokens: List[List[str]]) -> Table:
         col = np.empty(len(tokens), dtype=object)
@@ -75,7 +66,7 @@ class TextLIME(LIMEBase, _TextSamplerMixin):
             st = (rng.random((s, k)) < p).astype(np.float32)
             st[0] = 1.0
             states.append(st)
-        return self._emit(table, states, tokens), self._pad_states(states)
+        return self._emit(table, states, tokens), pad_ragged_states(states)
 
     def _transform(self, table: Table) -> Table:
         result = super()._transform(table)
@@ -90,18 +81,9 @@ class TextSHAP(KernelSHAPBase, _TextSamplerMixin):
         rng = np.random.default_rng(int(self.seed))
         tokens = self._tokens(table)
         self._token_lists = tokens
-        self._dims = [max(len(t), 1) for t in tokens]
-        states = [self._coalitions(k, rng) for k in self._dims]
-        return self._emit(table, states, tokens), self._pad_states(states)
-
-    def _sample_weights(self, states: np.ndarray) -> np.ndarray:
-        from .base import shapley_kernel_weights
-
-        out = []
-        for i, k in enumerate(self._dims):
-            num_on = states[i, :, :k].sum(axis=-1)
-            out.append(shapley_kernel_weights(num_on, k))
-        return np.stack(out)
+        self._true_dims = [max(len(t), 1) for t in tokens]
+        states = [self._coalitions(k, rng) for k in self._true_dims]
+        return self._emit(table, states, tokens), pad_ragged_states(states)
 
     def _transform(self, table: Table) -> Table:
         result = super()._transform(table)

@@ -1,0 +1,41 @@
+"""Online learning: hashed sparse featurization + adaptive SGD learners.
+
+Reference: the vw module (~2.5k LoC, vw/VowpalWabbitBase.scala family) —
+rebuilt TPU-native: murmur-hashed namespaces on host, jitted sparse AdaGrad
+scans on device, spanning-tree AllReduce replaced by `pmean` over the mesh
+'data' axis (SURVEY §2.10).
+"""
+from .contextual_bandit import (
+    ContextualBanditMetrics,
+    VowpalWabbitContextualBandit,
+    VowpalWabbitContextualBanditModel,
+)
+from .featurizer import (
+    VectorZipper,
+    VowpalWabbitFeaturizer,
+    VowpalWabbitInteractions,
+    sparse_to_padded,
+)
+from .hashing import FeatureHasher, murmurhash3_32
+from .learners import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+
+__all__ = [
+    "murmurhash3_32",
+    "FeatureHasher",
+    "VowpalWabbitFeaturizer",
+    "VowpalWabbitInteractions",
+    "VectorZipper",
+    "sparse_to_padded",
+    "VowpalWabbitClassifier",
+    "VowpalWabbitClassificationModel",
+    "VowpalWabbitRegressor",
+    "VowpalWabbitRegressionModel",
+    "VowpalWabbitContextualBandit",
+    "VowpalWabbitContextualBanditModel",
+    "ContextualBanditMetrics",
+]
