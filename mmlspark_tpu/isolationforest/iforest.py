@@ -1,0 +1,189 @@
+"""Isolation Forest: native implementation (host tree build, device scoring).
+
+Reference: core isolationforest/IsolationForest.scala:18-62, which wraps the
+external JVM library com.linkedin.isolation-forest (SURVEY §2.9 item 5 —
+external engine the TPU build must re-implement, not wrap).
+
+Design: iTrees are grown on host from small subsamples (cheap, O(T·s·log s))
+and packed into dense (num_trees, max_nodes) arrays; scoring — the data-sized
+cost — is one jitted fixed-depth traversal over all (row, tree) pairs on
+device, MXU/VPU-friendly gathers instead of per-row recursion.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.registry import register_stage
+from ..core.schema import Table, features_matrix
+
+__all__ = ["IsolationForest", "IsolationForestModel"]
+
+
+def _c(n) -> float:
+    """Average path length of an unsuccessful BST search: 2H(n-1) - 2(n-1)/n."""
+    n = float(n)
+    if n <= 1.0:
+        return 0.0
+    return 2.0 * (np.log(n - 1.0) + 0.5772156649) - 2.0 * (n - 1.0) / n
+
+
+def _build_tree(x: np.ndarray, rng: np.random.Generator, max_depth: int,
+                feature_idx: np.ndarray):
+    """Grow one iTree; returns dict of dense node arrays."""
+    max_nodes = 2 ** (max_depth + 1) - 1
+    feature = np.zeros(max_nodes, np.int32)
+    threshold = np.zeros(max_nodes, np.float32)
+    left = np.arange(max_nodes, dtype=np.int32)   # leaves self-loop
+    right = np.arange(max_nodes, dtype=np.int32)
+    adjust = np.zeros(max_nodes, np.float32)      # c(|leaf|) path correction
+    depth_at = np.zeros(max_nodes, np.float32)
+
+    stack = [(0, x, 0)]  # (node id, rows, depth)
+    while stack:
+        node, rows, depth = stack.pop()
+        depth_at[node] = depth
+        n = len(rows)
+        if depth >= max_depth or n <= 1:
+            adjust[node] = _c(n)
+            continue
+        f = int(feature_idx[int(rng.integers(len(feature_idx)))])
+        lo, hi = rows[:, f].min(), rows[:, f].max()
+        if lo == hi:
+            adjust[node] = _c(n)
+            continue
+        thr = float(rng.uniform(lo, hi))
+        mask = rows[:, f] < thr
+        feature[node] = f
+        threshold[node] = thr
+        lc, rc = 2 * node + 1, 2 * node + 2
+        left[node], right[node] = lc, rc
+        stack.append((lc, rows[mask], depth + 1))
+        stack.append((rc, rows[~mask], depth + 1))
+    return feature, threshold, left, right, adjust, depth_at
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _path_lengths(x, feature, threshold, left, right, adjust, depth_at,
+                  max_depth: int):
+    """Average path length per row over all trees.
+
+    x: (n, d); tree arrays: (T, max_nodes).  Fixed-depth traversal: leaves
+    self-loop so extra iterations are no-ops.
+    """
+
+    def per_row(xi):
+        node = jnp.zeros(feature.shape[0], jnp.int32)  # (T,)
+
+        def step(_, node):
+            f = jnp.take_along_axis(feature, node[:, None], axis=1)[:, 0]
+            thr = jnp.take_along_axis(threshold, node[:, None], axis=1)[:, 0]
+            lc = jnp.take_along_axis(left, node[:, None], axis=1)[:, 0]
+            rc = jnp.take_along_axis(right, node[:, None], axis=1)[:, 0]
+            return jnp.where(xi[f] < thr, lc, rc).astype(jnp.int32)
+
+        node = jax.lax.fori_loop(0, max_depth, step, node)
+        h = (
+            jnp.take_along_axis(depth_at, node[:, None], axis=1)[:, 0]
+            + jnp.take_along_axis(adjust, node[:, None], axis=1)[:, 0]
+        )
+        return jnp.mean(h)
+
+    return jax.vmap(per_row)(x)
+
+
+@register_stage
+class IsolationForest(Estimator):
+    """Parameter names follow the reference wrapper (IsolationForest.scala)."""
+
+    features_col = Param("features column", default="features")
+    prediction_col = Param("outlier label column (1 = outlier)",
+                           default="predicted_label")
+    score_col = Param("anomaly score column", default="outlier_score")
+    num_estimators = Param("number of trees", default=100,
+                           converter=TypeConverters.to_int)
+    max_samples = Param("subsample size per tree", default=256,
+                        converter=TypeConverters.to_int)
+    max_features = Param("fraction of features per tree", default=1.0,
+                         converter=TypeConverters.to_float)
+    bootstrap = Param("sample with replacement", default=False,
+                      converter=TypeConverters.to_bool)
+    contamination = Param("expected outlier fraction (0 = score only)",
+                          default=0.0, converter=TypeConverters.to_float)
+    seed = Param("rng seed", default=0, converter=TypeConverters.to_int)
+
+    def _fit(self, table: Table) -> "IsolationForestModel":
+        x = features_matrix(table[self.features_col])
+        n, d = x.shape
+        rng = np.random.default_rng(int(self.seed))
+        s = min(int(self.max_samples), n)
+        max_depth = max(int(np.ceil(np.log2(max(s, 2)))), 1)
+        n_feat = max(int(np.ceil(float(self.max_features) * d)), 1)
+
+        trees = []
+        for _ in range(int(self.num_estimators)):
+            idx = (
+                rng.integers(0, n, size=s)
+                if self.bootstrap
+                else rng.choice(n, size=s, replace=False)
+            )
+            feats = rng.choice(d, size=n_feat, replace=False)
+            trees.append(_build_tree(x[idx], rng, max_depth, feats))
+
+        packed = tuple(np.stack(a) for a in zip(*trees))
+        # contamination=0 is score-only mode: threshold above the score range
+        # (scores are in (0, 1]) so no row is ever labeled an outlier —
+        # matching the reference engine's behavior
+        model = IsolationForestModel(
+            trees=packed, max_depth=max_depth, subsample_size=s,
+            features_col=self.features_col,
+            prediction_col=self.prediction_col, score_col=self.score_col,
+            threshold=2.0,
+        )
+        if float(self.contamination) > 0:
+            scores = model._scores(x)
+            model.set(threshold=float(
+                np.quantile(scores, 1.0 - float(self.contamination))
+            ))
+        return model
+
+
+@register_stage
+class IsolationForestModel(Model):
+    features_col = Param("features column", default="features")
+    prediction_col = Param("outlier label column", default="predicted_label")
+    score_col = Param("anomaly score column", default="outlier_score")
+    max_depth = Param("tree depth limit", default=8,
+                      converter=TypeConverters.to_int)
+    subsample_size = Param("per-tree subsample size", default=256,
+                           converter=TypeConverters.to_int)
+    threshold = Param("outlier score threshold (2.0 = score-only, never "
+                      "labels)", default=2.0, converter=TypeConverters.to_float)
+    trees = ComplexParam("packed tree arrays")
+
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        feature, threshold, left, right, adjust, depth_at = self.trees
+        h = _path_lengths(
+            jnp.asarray(x), jnp.asarray(feature), jnp.asarray(threshold),
+            jnp.asarray(left), jnp.asarray(right), jnp.asarray(adjust),
+            jnp.asarray(depth_at), max_depth=int(self.max_depth),
+        )
+        cn = _c(int(self.subsample_size))
+        return np.asarray(2.0 ** (-np.asarray(h) / max(cn, 1e-9)), np.float64)
+
+    def _transform(self, table: Table) -> Table:
+        x = features_matrix(table[self.features_col])
+        scores = (
+            self._scores(x) if len(x) else np.zeros((0,), np.float64)
+        )
+        out = table.with_column(self.score_col, scores)
+        return out.with_column(
+            self.prediction_col,
+            (scores >= float(self.threshold)).astype(np.int64),
+        )

@@ -1,0 +1,39 @@
+"""Recommendation: SAR collaborative filtering + ranking evaluation/tuning.
+
+Reference: core recommendation/ (~1.3k LoC, SAR.scala:36-260, SARModel.scala,
+RecommendationIndexer.scala, RankingAdapter.scala, RankingEvaluator.scala,
+RankingTrainValidationSplit.scala).
+"""
+from .indexer import RecommendationIndexer, RecommendationIndexerModel
+from .ranking import (
+    RankingAdapter,
+    RankingAdapterModel,
+    RankingEvaluator,
+    map_at_k,
+    ndcg_at_k,
+    precision_at_k,
+    recall_at_k,
+)
+from .sar import SAR, SARModel
+from .tvs import (
+    RankingTrainValidationSplit,
+    RankingTrainValidationSplitModel,
+    per_user_split,
+)
+
+__all__ = [
+    "SAR",
+    "SARModel",
+    "RecommendationIndexer",
+    "RecommendationIndexerModel",
+    "RankingAdapter",
+    "RankingAdapterModel",
+    "RankingEvaluator",
+    "RankingTrainValidationSplit",
+    "RankingTrainValidationSplitModel",
+    "per_user_split",
+    "ndcg_at_k",
+    "map_at_k",
+    "precision_at_k",
+    "recall_at_k",
+]

@@ -1,0 +1,174 @@
+"""SAR: Smart Adaptive Recommendations (item-item collaborative filtering).
+
+Reference: core recommendation/SAR.scala:36-260 (co-occurrence similarity
+jaccard/lift, time-decayed user affinities) and SARModel.scala (178 LoC,
+recommend-for-all-users via BLAS gemv over broadcast item factors).
+
+TPU-native redesign: the reference computes co-occurrence with DataFrame
+self-joins and scores users with per-row gemv; here the binarized user-item
+matrix B lives on device, co-occurrence C = Bᵀ B is ONE MXU matmul, and
+recommend-for-all-users is the (users × items) @ (items × items) matmul +
+top-k — all jitted, bfloat16-friendly, batch-sharded over the mesh for large
+user counts.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.registry import register_stage
+from ..core.schema import Table
+
+__all__ = ["SAR", "SARModel"]
+
+
+@jax.jit
+def _cooccurrence(B):
+    return B.T @ B
+
+
+@jax.jit
+def _jaccard(C):
+    diag = jnp.diag(C)
+    denom = diag[:, None] + diag[None, :] - C
+    return jnp.where(denom > 0, C / jnp.maximum(denom, 1e-12), 0.0)
+
+
+@jax.jit
+def _lift(C):
+    diag = jnp.diag(C)
+    denom = diag[:, None] * diag[None, :]
+    return jnp.where(denom > 0, C / jnp.maximum(denom, 1e-12), 0.0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_unseen(scores, seen, k: int):
+    """Mask already-seen items to -inf, take top-k per user."""
+    masked = jnp.where(seen > 0, -jnp.inf, scores)
+    vals, idx = jax.lax.top_k(masked, k)
+    return vals, idx
+
+
+@register_stage
+class SAR(Estimator):
+    """Fit time-decayed affinities + item-item similarity.
+
+    Expects integer-indexed user/item columns (use RecommendationIndexer
+    first, as the reference pipelines do).
+    """
+
+    user_col = Param("user index column", default="user")
+    item_col = Param("item index column", default="item")
+    rating_col = Param("rating column", default="rating")
+    timestamp_col = Param("optional timestamp column (seconds)", default="")
+    similarity_function = Param("jaccard|lift|cooccurrence", default="jaccard")
+    time_decay_coeff = Param("half-life in days for affinity decay", default=30,
+                             converter=TypeConverters.to_int)
+    support_threshold = Param("min co-occurrence support", default=4,
+                              converter=TypeConverters.to_int)
+
+    def _fit(self, table: Table) -> "SARModel":
+        users = np.asarray(table[self.user_col], np.int64)
+        items = np.asarray(table[self.item_col], np.int64)
+        n_users = int(users.max()) + 1 if len(users) else 0
+        n_items = int(items.max()) + 1 if len(items) else 0
+        ratings = (
+            np.asarray(table[self.rating_col], np.float32)
+            if self.rating_col in table
+            else np.ones(len(table), np.float32)
+        )
+
+        ts_col = self.timestamp_col
+        if ts_col and ts_col in table:
+            ts = np.asarray(table[ts_col], np.float64)
+            ref = ts.max()
+            half_life_s = float(self.time_decay_coeff) * 86400.0
+            decay = np.power(2.0, -(ref - ts) / half_life_s).astype(np.float32)
+        else:
+            decay = np.ones(len(table), np.float32)
+
+        # affinity: sum of decayed ratings per (user, item)
+        affinity = np.zeros((n_users, n_items), np.float32)
+        np.add.at(affinity, (users, items), ratings * decay)
+
+        # item-item co-occurrence on device (one MXU matmul)
+        B = jnp.asarray((affinity > 0).astype(np.float32))
+        C = _cooccurrence(B)
+        counts = jnp.diag(C)  # item occurrence counts, saved before threshold
+        C = jnp.where(C >= float(self.support_threshold), C, 0.0)
+        # keep self-co-occurrence for the similarity denominators
+        C = C.at[jnp.diag_indices(C.shape[0])].set(counts)
+
+        fn = self.similarity_function
+        if fn == "jaccard":
+            S = _jaccard(C)
+        elif fn == "lift":
+            S = _lift(C)
+        elif fn == "cooccurrence":
+            S = C
+        else:
+            raise ValueError(f"unknown similarity_function {fn!r}")
+        S = S.at[jnp.diag_indices(S.shape[0])].set(0.0)
+
+        return SARModel(
+            user_affinity=affinity,
+            item_similarity=np.asarray(S),
+            user_col=self.user_col, item_col=self.item_col,
+            rating_col=self.rating_col,
+        )
+
+
+@register_stage
+class SARModel(Model):
+    """Scores = affinity @ similarity; top-k with seen-item masking.
+
+    Reference: SARModel.scala recommendForAllUsers / transform.
+    """
+
+    user_col = Param("user index column", default="user")
+    item_col = Param("item index column", default="item")
+    rating_col = Param("rating column", default="rating")
+    prediction_col = Param("prediction column", default="prediction")
+    user_affinity = ComplexParam("(n_users, n_items) affinity matrix")
+    item_similarity = ComplexParam("(n_items, n_items) similarity matrix")
+
+    def _scores(self) -> jnp.ndarray:
+        A = jnp.asarray(self.user_affinity)
+        S = jnp.asarray(self.item_similarity)
+        return A @ S
+
+    def recommend_for_all_users(self, k: int = 10) -> Table:
+        """Per-user top-k unseen items: Table(user, recommendations, scores)."""
+        A = np.asarray(self.user_affinity)
+        k = min(int(k), A.shape[1])  # lax.top_k requires k <= item count
+        scores = self._scores()
+        vals, idx = _topk_unseen(scores, jnp.asarray((A > 0).astype(np.float32)), k)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        n_users = A.shape[0]
+        recs = np.empty(n_users, dtype=object)
+        scs = np.empty(n_users, dtype=object)
+        for u in range(n_users):
+            good = np.isfinite(vals[u])
+            recs[u] = idx[u][good].astype(np.int64)
+            scs[u] = vals[u][good].astype(np.float32)
+        return Table({
+            self.user_col: np.arange(n_users, dtype=np.int64),
+            "recommendations": recs,
+            "scores": scs,
+        })
+
+    def _transform(self, table: Table) -> Table:
+        users = np.asarray(table[self.user_col], np.int64)
+        items = np.asarray(table[self.item_col], np.int64)
+        scores = np.asarray(self._scores())
+        n_users, n_items = scores.shape
+        ok = (users >= 0) & (users < n_users) & (items >= 0) & (items < n_items)
+        out = np.zeros(len(table), np.float32)
+        out[ok] = scores[users[ok], items[ok]]
+        return table.with_column(self.prediction_col, out)

@@ -1,0 +1,147 @@
+"""automl + isolation-forest suites — reference: automl/src/test
+VerifyTuneHyperparameters / VerifyFindBestModel, isolationforest wrapper tests.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.automl import (
+    DiscreteHyperParam,
+    FindBestModel,
+    GridSpace,
+    HyperparamBuilder,
+    IntRangeHyperParam,
+    LogRangeHyperParam,
+    RandomSpace,
+    TuneHyperparameters,
+    evaluate_model,
+)
+from mmlspark_tpu.isolationforest import IsolationForest
+from mmlspark_tpu.models.linear import LogisticRegression
+
+
+@pytest.fixture
+def cls_table():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(150, 4)).astype(np.float32)
+    y = (x[:, 0] - x[:, 1] > 0).astype(np.int64)
+    return Table({"features": x, "label": y})
+
+
+def test_grid_space_product():
+    space = (
+        HyperparamBuilder()
+        .add_hyperparam("a", DiscreteHyperParam([1, 2, 3]))
+        .add_hyperparam("b", DiscreteHyperParam(["x", "y"]))
+        .build()
+    )
+    maps = list(GridSpace(space).param_maps())
+    assert len(maps) == 6
+    assert {"a": 1, "b": "x"} in maps
+
+
+def test_random_space_sampling():
+    space = (
+        HyperparamBuilder()
+        .add_hyperparam("lr", LogRangeHyperParam(1e-4, 1.0))
+        .add_hyperparam("steps", IntRangeHyperParam(10, 100))
+        .build()
+    )
+    maps = list(RandomSpace(space, num_samples=20, seed=1).param_maps())
+    assert len(maps) == 20
+    assert all(1e-4 <= m["lr"] <= 1.0 for m in maps)
+    assert all(10 <= m["steps"] < 100 for m in maps)
+
+
+def test_tune_hyperparameters(cls_table):
+    space = (
+        HyperparamBuilder()
+        .add_hyperparam("reg_param", DiscreteHyperParam([1e-4, 10.0]))
+        .build()
+    )
+    tuned = TuneHyperparameters(
+        models=[LogisticRegression(max_iter=50)],
+        param_space=GridSpace(space),
+        evaluation_metric="accuracy", num_folds=3, parallelism=2, seed=2,
+    ).fit(cls_table)
+    assert tuned.best_metric > 0.85
+    assert len(tuned.all_metrics) == 2
+    # heavy regularization must lose
+    best_params = [
+        m for m in tuned.all_metrics if m["metric"] == tuned.best_metric
+    ]
+    assert best_params[0]["params"]["reg_param"] == 1e-4
+    out = tuned.transform(cls_table)
+    assert "prediction" in out
+
+
+def test_find_best_model(cls_table):
+    good = LogisticRegression(max_iter=100).fit(cls_table)
+    bad = LogisticRegression(max_iter=1, learning_rate=1e-6).fit(cls_table)
+    best = FindBestModel(models=[bad, good],
+                         evaluation_metric="accuracy").fit(cls_table)
+    assert best.best_model is good
+    assert len(best.all_model_metrics) == 2
+
+
+def test_evaluate_model_regression(cls_table):
+    from mmlspark_tpu.models.linear import LinearRegression
+
+    t = Table({
+        "features": np.asarray(cls_table["features"]),
+        "label": np.asarray(cls_table["features"])[:, 0] * 2.0,
+    })
+    m = LinearRegression().fit(t)
+    rmse = evaluate_model(m, t, "rmse")
+    assert rmse < 0.5
+
+
+def test_isolation_forest_separates_outliers():
+    rng = np.random.default_rng(3)
+    inliers = rng.normal(size=(300, 2)).astype(np.float32)
+    outliers = rng.normal(size=(15, 2)).astype(np.float32) * 0.5 + 6.0
+    x = np.concatenate([inliers, outliers])
+    t = Table({"features": x})
+    model = IsolationForest(num_estimators=100, max_samples=128,
+                            contamination=0.05, seed=4).fit(t)
+    out = model.transform(t)
+    scores = out["outlier_score"]
+    assert scores[300:].mean() > scores[:300].mean() + 0.1
+    preds = out["predicted_label"]
+    # most true outliers flagged, few inliers flagged
+    assert preds[300:].mean() > 0.8
+    assert preds[:300].mean() < 0.1
+
+
+def test_isolation_forest_score_only_mode():
+    rng = np.random.default_rng(5)
+    t = Table({"features": rng.normal(size=(100, 3)).astype(np.float32)})
+    model = IsolationForest(num_estimators=20, contamination=0.0).fit(t)
+    out = model.transform(t)
+    assert np.all((out["outlier_score"] > 0) & (out["outlier_score"] < 1))
+    # score-only mode must label nothing an outlier
+    assert out["predicted_label"].sum() == 0
+
+
+def test_nan_metrics_never_win():
+    from mmlspark_tpu.automl.tune import _select_best
+
+    assert _select_best([0.4, float("nan"), 0.9], True) == 2
+    assert _select_best([float("nan"), 2.0, 5.0], False) == 1
+    with pytest.raises(ValueError):
+        _select_best([float("nan")], True)
+
+
+def test_isolation_forest_empty_transform():
+    rng = np.random.default_rng(6)
+    t = Table({"features": rng.normal(size=(50, 3)).astype(np.float32)})
+    model = IsolationForest(num_estimators=10).fit(t)
+    assert len(model.transform(t.slice(0, 0))) == 0
+
+
+def test_iforest_roundtrip():
+    from fuzzing import fuzz
+
+    rng = np.random.default_rng(7)
+    t = Table({"features": rng.normal(size=(60, 3)).astype(np.float32)})
+    fuzz(IsolationForest(num_estimators=10, max_samples=32), t)

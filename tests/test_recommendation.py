@@ -1,0 +1,168 @@
+"""Recommendation suite — reference: recommendation/src/test SARSpec /
+RankingAdapterSpec / RankingTrainValidationSplitSpec behaviors.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.recommendation import (
+    SAR,
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RecommendationIndexer,
+    map_at_k,
+    ndcg_at_k,
+    per_user_split,
+    precision_at_k,
+    recall_at_k,
+)
+
+
+@pytest.fixture
+def ratings():
+    """3 user groups with distinct tastes over 9 items."""
+    rng = np.random.default_rng(0)
+    rows_u, rows_i, rows_r = [], [], []
+    for u in range(30):
+        group = u % 3
+        liked = np.arange(group * 3, group * 3 + 3)
+        for i in liked:
+            rows_u.append(u)
+            rows_i.append(int(i))
+            rows_r.append(5.0)
+        # one random cross-group item
+        rows_u.append(u)
+        rows_i.append(int(rng.integers(0, 9)))
+        rows_r.append(1.0)
+    return Table({
+        "user": np.array(rows_u, np.int64),
+        "item": np.array(rows_i, np.int64),
+        "rating": np.array(rows_r, np.float32),
+    })
+
+
+def test_metric_functions():
+    assert ndcg_at_k([1, 2, 3], [1, 2, 3], 3) == pytest.approx(1.0)
+    assert ndcg_at_k([9, 8, 1], [1], 3) < 0.6
+    assert precision_at_k([1, 2, 3, 4], [1, 3], 4) == pytest.approx(0.5)
+    assert recall_at_k([1, 2], [1, 2, 3, 4], 2) == pytest.approx(0.5)
+    assert map_at_k([1, 9, 2], [1, 2], 3) == pytest.approx((1.0 + 2 / 3) / 2)
+    assert ndcg_at_k([], [], 5) == 0.0
+
+
+def test_sar_similarity_structure(ratings):
+    model = SAR(support_threshold=1).fit(ratings)
+    S = np.asarray(model.item_similarity)
+    assert S.shape == (9, 9)
+    # within-group items co-liked -> higher sim than cross-group
+    within = np.mean([S[0, 1], S[1, 2], S[3, 4], S[6, 7]])
+    cross = np.mean([S[0, 4], S[1, 6], S[2, 7]])
+    assert within > cross
+    assert np.allclose(np.diag(S), 0.0)
+
+
+def test_sar_recommendations_respect_groups(ratings):
+    model = SAR(support_threshold=1).fit(ratings)
+    # drop item 2 from user 0's history to create a recommendable gap
+    mask = ~((ratings["user"] == 0) & (ratings["item"] == 2))
+    model2 = SAR(support_threshold=1).fit(ratings.filter(mask))
+    recs = model2.recommend_for_all_users(3)
+    u0 = recs["recommendations"][0]
+    assert 2 in list(u0), f"expected item 2 recommended to user 0, got {u0}"
+
+
+def test_sar_transform_scores(ratings):
+    model = SAR(support_threshold=1).fit(ratings)
+    out = model.transform(ratings)
+    assert "prediction" in out
+    assert np.all(np.isfinite(out["prediction"]))
+
+
+def test_sar_time_decay():
+    t = Table({
+        "user": np.array([0, 0, 1, 1], np.int64),
+        "item": np.array([0, 1, 0, 1], np.int64),
+        "rating": np.ones(4, np.float32),
+        "ts": np.array([0.0, 100 * 86400.0, 100 * 86400.0, 100 * 86400.0]),
+    })
+    model = SAR(timestamp_col="ts", time_decay_coeff=30,
+                support_threshold=1).fit(t)
+    A = np.asarray(model.user_affinity)
+    # user0/item0 is 100 days old with 30-day half-life -> ~0.1 of fresh
+    assert A[0, 0] < 0.15 * A[0, 1]
+
+
+def test_sar_similarity_functions_differ(ratings):
+    mj = SAR(similarity_function="jaccard", support_threshold=1).fit(ratings)
+    ml = SAR(similarity_function="lift", support_threshold=1).fit(ratings)
+    mc = SAR(similarity_function="cooccurrence", support_threshold=1).fit(ratings)
+    assert not np.allclose(mj.item_similarity, ml.item_similarity)
+    assert np.asarray(mc.item_similarity).max() > 1.0  # raw counts
+
+
+def test_indexer_roundtrip():
+    t = Table({
+        "customerID": ["alice", "bob", "alice"],
+        "itemID": ["x", "y", "y"],
+        "rating": np.array([1.0, 2.0, 3.0]),
+    })
+    model = RecommendationIndexer().fit(t)
+    out = model.transform(t)
+    assert out["user"].max() == 1 and out["item"].max() == 1
+    assert model.recover_user(int(out["user"][0])) == "alice"
+    # unseen ids are filtered
+    t2 = Table({"customerID": ["carol"], "itemID": ["x"],
+                "rating": np.array([1.0])})
+    assert len(model.transform(t2)) == 0
+
+
+def test_ranking_adapter_and_evaluator(ratings):
+    adapter = RankingAdapter(recommender=SAR(support_threshold=1), k=5)
+    am = adapter.fit(ratings)
+    ranked = am.transform(ratings)
+    assert set(ranked.column_names) == {"user", "recommendations", "ground_truth"}
+    ev = RankingEvaluator(metric_name="ndcgAt", k=5)
+    metric = ev.evaluate(ranked)
+    assert 0.0 <= metric <= 1.0
+
+
+def test_per_user_split(ratings):
+    train, valid = per_user_split(ratings, "user", 0.75, seed=1)
+    assert len(train) + len(valid) == len(ratings)
+    # every user present in train
+    assert set(np.unique(train["user"])) == set(np.unique(ratings["user"]))
+
+
+def test_ranking_tvs_picks_best(ratings):
+    tvs = RankingTrainValidationSplit(
+        estimator=SAR(support_threshold=1),
+        param_grid=[{"similarity_function": "jaccard"},
+                    {"similarity_function": "lift"}],
+        evaluator=RankingEvaluator(metric_name="ndcgAt", k=5),
+        train_ratio=0.75, seed=2,
+    )
+    model = tvs.fit(ratings)
+    assert len(model.validation_metrics) == 2
+    out = model.transform(ratings)
+    assert "prediction" in out
+
+
+def test_recommend_k_exceeds_catalog(ratings):
+    model = SAR(support_threshold=1).fit(ratings)
+    recs = model.recommend_for_all_users(50)  # only 9 items exist
+    assert all(len(r) <= 9 for r in recs["recommendations"])
+
+
+def test_indexer_empty_table():
+    t = Table({
+        "customerID": ["alice"], "itemID": ["x"], "rating": np.array([1.0]),
+    })
+    model = RecommendationIndexer().fit(t)
+    assert len(model.transform(t.slice(0, 0))) == 0
+
+
+def test_sar_roundtrip(ratings):
+    from fuzzing import fuzz
+
+    fuzz(SAR(support_threshold=1), ratings)
