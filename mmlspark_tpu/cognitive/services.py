@@ -1,0 +1,211 @@
+"""Speech, anomaly detection, translation, form recognizer, Bing search.
+
+Reference: cognitive/SpeechToText.scala (131 LoC), AnomalyDetection.scala
+(249 LoC), TextTranslator.scala (406 LoC), FormRecognizer.scala (353 LoC),
+BingImageSearch.scala (309 LoC).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+from urllib.parse import urlencode
+
+from ..core.params import Param, ServiceParam, TypeConverters
+from ..core.registry import register_stage
+from ..core.schema import Table
+from .base import BasicAsyncReply, CognitiveServicesBase
+from .vision import HasImageInput
+
+__all__ = [
+    "SpeechToText",
+    "DetectLastAnomaly",
+    "DetectAnomalies",
+    "Translate",
+    "Detect",
+    "BreakSentence",
+    "Transliterate",
+    "AnalyzeLayout",
+    "AnalyzeInvoices",
+    "BingImageSearch",
+]
+
+
+@register_stage
+class SpeechToText(CognitiveServicesBase):
+    """REST speech recognition (SpeechToText.scala — the SDK streaming
+    variant is host-side audio plumbing with the same output schema)."""
+
+    _domain = "stt.speech.microsoft.com"
+    _path = "/speech/recognition/conversation/cognitiveservices/v1"
+    audio_col = Param("column of audio bytes (wav)", default="audio")
+    language = ServiceParam("recognition language", default="en-US")
+    format = Param("simple|detailed", default="simple")
+
+    def _prepare_url(self, table, i):
+        q = urlencode({"language": self.resolve("language", table, i),
+                       "format": self.format})
+        return f"{self._base_url()}?{q}"
+
+    def _headers(self, table, i):
+        h = super()._headers(table, i)
+        h["Content-Type"] = "audio/wav; codecs=audio/pcm; samplerate=16000"
+        return h
+
+    def _prepare_entity(self, table, i):
+        a = table[self.audio_col][i]
+        return bytes(a) if a is not None else None
+
+
+class _AnomalyBase(CognitiveServicesBase):
+    """Series payload from columns of timestamps+values
+    (AnomalyDetection.scala)."""
+
+    timestamps_col = Param("column of per-row timestamp lists", default="timestamps")
+    values_col = Param("column of per-row value lists", default="values")
+    granularity = ServiceParam("series granularity", default="daily")
+    sensitivity = ServiceParam("sensitivity 0-99", default=None)
+
+    def _prepare_entity(self, table, i):
+        ts = table[self.timestamps_col][i]
+        vals = table[self.values_col][i]
+        if ts is None or vals is None:
+            return None
+        series = [{"timestamp": str(t), "value": float(v)}
+                  for t, v in zip(ts, vals)]
+        body = {"series": series,
+                "granularity": self.resolve("granularity", table, i)}
+        sens = self.resolve("sensitivity", table, i)
+        if sens is not None:
+            body["sensitivity"] = int(sens)
+        return json.dumps(body).encode()
+
+
+@register_stage
+class DetectLastAnomaly(_AnomalyBase):
+    _path = "/anomalydetector/v1.0/timeseries/last/detect"
+
+
+@register_stage
+class DetectAnomalies(_AnomalyBase):
+    _path = "/anomalydetector/v1.0/timeseries/entire/detect"
+
+
+class _TranslatorBase(CognitiveServicesBase):
+    _domain = "cognitive.microsofttranslator.com"
+    text_col = Param("input text column", default="text")
+
+    def _base_url(self) -> str:
+        if self.url:
+            return self.url
+        return f"https://api.{self._domain}{self._path}"
+
+    def _prepare_entity(self, table, i):
+        t = table[self.text_col][i]
+        return None if t is None else json.dumps([{"Text": str(t)}]).encode()
+
+
+@register_stage
+class Translate(_TranslatorBase):
+    _path = "/translate"
+    to_language = ServiceParam("target language(s), comma-joined", default="en")
+
+    def _prepare_url(self, table, i):
+        to = str(self.resolve("to_language", table, i))
+        q = [("api-version", "3.0")] + [("to", x) for x in to.split(",")]
+        return f"{self._base_url()}?{urlencode(q)}"
+
+
+@register_stage
+class Detect(_TranslatorBase):
+    _path = "/detect"
+
+    def _prepare_url(self, table, i):
+        return f"{self._base_url()}?api-version=3.0"
+
+
+@register_stage
+class BreakSentence(_TranslatorBase):
+    _path = "/breaksentence"
+
+    def _prepare_url(self, table, i):
+        return f"{self._base_url()}?api-version=3.0"
+
+
+@register_stage
+class Transliterate(_TranslatorBase):
+    _path = "/transliterate"
+    language = ServiceParam("source language", default="ja")
+    from_script = ServiceParam("source script", default="Jpan")
+    to_script = ServiceParam("target script", default="Latn")
+
+    def _prepare_url(self, table, i):
+        q = urlencode({
+            "api-version": "3.0",
+            "language": self.resolve("language", table, i),
+            "fromScript": self.resolve("from_script", table, i),
+            "toScript": self.resolve("to_script", table, i),
+        })
+        return f"{self._base_url()}?{q}"
+
+
+class _FormRecognizerBase(HasImageInput, BasicAsyncReply):
+    """Async layout/invoice analysis (FormRecognizer.scala); URL-mode bodies
+    use the form-recognizer 'source' field."""
+
+    _url_key = "source"
+
+
+@register_stage
+class AnalyzeLayout(_FormRecognizerBase):
+    _path = "/formrecognizer/v2.1/layout/analyze"
+
+
+@register_stage
+class AnalyzeInvoices(_FormRecognizerBase):
+    _path = "/formrecognizer/v2.1/prebuilt/invoice/analyze"
+
+
+@register_stage
+class BingImageSearch(CognitiveServicesBase):
+    """Bing image search (BingImageSearch.scala): GET with query params."""
+
+    _domain = "api.bing.microsoft.com"
+    _path = "/v7.0/images/search"
+    query_col = Param("search query column", default="query")
+    count = Param("results per query", default=10,
+                  converter=TypeConverters.to_int)
+    offset_col = Param("optional per-row offset column", default="")
+
+    def _base_url(self) -> str:
+        return self.url or f"https://{self._domain}{self._path}"
+
+    def _prepare_method(self):
+        return "GET"
+
+    def _prepare_entity(self, table, i):
+        q = table[self.query_col][i]
+        return b"" if q is not None else None
+
+    def _prepare_url(self, table, i):
+        params = {"q": str(table[self.query_col][i]),
+                  "count": int(self.count)}
+        if self.offset_col:
+            params["offset"] = int(table[self.offset_col][i])
+        return f"{self._base_url()}?{urlencode(params)}"
+
+    @staticmethod
+    def get_urls(table: Table, output_col: str = "output",
+                 url_col: str = "imageUrl") -> Table:
+        """Flatten contentUrls out of search responses
+        (BingImageSearch.getUrlTransformer)."""
+        import numpy as np
+
+        urls = []
+        for r in table[output_col]:
+            for v in (r or {}).get("value", []):
+                if "contentUrl" in v:
+                    urls.append(v["contentUrl"])
+        arr = np.empty(len(urls), dtype=object)
+        for i, u in enumerate(urls):
+            arr[i] = u
+        return Table({url_col: arr})

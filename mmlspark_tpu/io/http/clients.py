@@ -1,0 +1,122 @@
+"""HTTP clients: retry/backoff handling + bounded-concurrency async pipeline.
+
+Reference: core io/http/HTTPClients.scala:65-156 (`HandlingUtils.advanced`
+retry-with-backoff incl. 429 Retry-After) and Clients.scala:48-120
+(`AsyncClient`: bounded-concurrency Future pipeline with ordered results).
+
+Host-side only (urllib + thread pool) — the data plane between client and
+device is Table columns, exactly like the reference's executor-side Apache
+HttpClient pools.
+"""
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable, List, Optional, Sequence
+
+from .schema import HTTPRequestData, HTTPResponseData
+
+__all__ = ["send_request", "HandlingUtils", "AsyncHTTPClient",
+           "get_shared_client"]
+
+
+def send_request(req: HTTPRequestData, timeout: float = 60.0) -> HTTPResponseData:
+    """One HTTP exchange; transport errors become status 0 / reason text."""
+    r = urllib.request.Request(
+        req.url, data=req.entity, headers=req.headers or {},
+        method=req.method,
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return HTTPResponseData(
+                status_code=resp.status, reason=resp.reason or "",
+                headers=dict(resp.headers.items()), entity=resp.read(),
+            )
+    except urllib.error.HTTPError as e:
+        return HTTPResponseData(
+            status_code=e.code, reason=str(e.reason),
+            headers=dict(e.headers.items()) if e.headers else {},
+            entity=e.read(),
+        )
+    except Exception as e:  # URLError, timeout, connection refused...
+        return HTTPResponseData(status_code=0, reason=f"{type(e).__name__}: {e}")
+
+
+class HandlingUtils:
+    """Retry policies (HTTPClients.scala HandlingUtils.advanced)."""
+
+    RETRYABLE = frozenset({0, 408, 429, 500, 502, 503, 504})
+
+    @staticmethod
+    def advanced(req: HTTPRequestData, backoffs_ms: Sequence[int] = (100, 500, 1000),
+                 timeout: float = 60.0) -> HTTPResponseData:
+        """Send with retries: exponential backoff list; 429 honors
+        Retry-After; non-retryable statuses return immediately."""
+        resp = send_request(req, timeout)
+        for backoff in backoffs_ms:
+            if resp.status_code not in HandlingUtils.RETRYABLE:
+                return resp
+            wait_s = backoff / 1000.0
+            if resp.status_code == 429:
+                ra = resp.headers.get("Retry-After") or resp.headers.get(
+                    "retry-after"
+                )
+                if ra is not None:
+                    try:
+                        wait_s = max(float(ra), wait_s)
+                    except ValueError:
+                        pass
+            time.sleep(wait_s)
+            resp = send_request(req, timeout)
+        return resp
+
+    @staticmethod
+    def basic(req: HTTPRequestData, timeout: float = 60.0) -> HTTPResponseData:
+        return send_request(req, timeout)
+
+
+class AsyncHTTPClient:
+    """Bounded-concurrency request pipeline with ORDERED results.
+
+    Reference: Clients.scala:48 AsyncClient — requests are dispatched up to
+    `concurrency` at a time; results come back in submission order.
+    """
+
+    def __init__(self, concurrency: int = 8, timeout: float = 60.0,
+                 backoffs_ms: Sequence[int] = (100, 500, 1000)):
+        self.concurrency = int(concurrency)
+        self.timeout = float(timeout)
+        self.backoffs_ms = tuple(backoffs_ms)
+        self._pool = ThreadPoolExecutor(max_workers=self.concurrency)
+
+    def send(self, req: HTTPRequestData) -> HTTPResponseData:
+        return HandlingUtils.advanced(req, self.backoffs_ms, self.timeout)
+
+    def send_all(self, requests: Iterable[Optional[HTTPRequestData]]
+                 ) -> List[Optional[HTTPResponseData]]:
+        """None requests yield None responses (null-safe, like the
+        reference's sendRequestsWithContext)."""
+
+        def one(req):
+            if req is None:
+                return None
+            return self.send(req)
+
+        return list(self._pool.map(one, requests))
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+
+
+def get_shared_client(concurrency: int, timeout: float) -> AsyncHTTPClient:
+    """Process-shared client keyed by config (SharedVariable semantics) —
+    the one place the cache key is built, used by HTTPTransformer and every
+    cognitive service."""
+    from ...core.shared import shared_singleton
+
+    key = ("AsyncHTTPClient", int(concurrency), float(timeout))
+    return shared_singleton(
+        key, lambda: AsyncHTTPClient(int(concurrency), float(timeout))
+    )

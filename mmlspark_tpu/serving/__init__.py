@@ -1,0 +1,27 @@
+"""Model serving: embedded per-host HTTP servers + continuous batching.
+
+Reference: the Spark Serving L6 subsystem (~1.6k LoC; HTTPSourceV2/
+HTTPSinkV2/DistributedHTTPSource, SURVEY §2.4) — sub-millisecond data path:
+accept, batch, jitted transform, reply over the held socket.
+"""
+from .registry import ServiceRegistry, list_services, register_service
+from .server import (
+    CachedRequest,
+    ServiceInfo,
+    ServingServer,
+    WorkerServer,
+    make_reply,
+    parse_request,
+)
+
+__all__ = [
+    "ServingServer",
+    "WorkerServer",
+    "CachedRequest",
+    "ServiceInfo",
+    "parse_request",
+    "make_reply",
+    "ServiceRegistry",
+    "register_service",
+    "list_services",
+]

@@ -1,0 +1,106 @@
+"""Service registry: workers report endpoints to a coordinator service.
+
+Reference: HTTPSourceV2.scala DriverServiceUtils (:133-194) — the driver
+hosts a registry every worker POSTs its ServiceInfo{host,port,...} to, and
+HTTPSourceStateHolder.serviceInfoJson(name) exposes discovery (:409-416).
+
+In a multi-host jax job the registry runs on the coordinator (process 0);
+workers register their per-host serving endpoints over DCN.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..io.http.clients import send_request
+from ..io.http.schema import HTTPRequestData
+from .server import ServiceInfo
+
+__all__ = ["ServiceRegistry", "register_service", "list_services"]
+
+
+class ServiceRegistry:
+    """Tiny registry server: POST /register, GET /services."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._services: Dict[str, List[dict]] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path.rstrip("/") != "/register":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                info = json.loads(self.rfile.read(length))
+                with outer._lock:
+                    outer._services.setdefault(info["name"], []).append(info)
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def do_GET(self):
+                if not self.path.rstrip("/").startswith("/services"):
+                    self.send_error(404)
+                    return
+                name = self.path.rstrip("/").split("/")[-1]
+                with outer._lock:
+                    if name and name != "services":
+                        body = json.dumps(
+                            outer._services.get(name, [])
+                        ).encode()
+                    else:
+                        body = json.dumps(outer._services).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="svc-registry"
+        )
+
+    @property
+    def url(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self) -> str:
+        self._thread.start()
+        return self.url
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def services(self, name: Optional[str] = None):
+        with self._lock:
+            if name is not None:
+                return list(self._services.get(name, []))
+            return {k: list(v) for k, v in self._services.items()}
+
+
+def register_service(registry_url: str, info: ServiceInfo) -> bool:
+    resp = send_request(HTTPRequestData(
+        url=registry_url.rstrip("/") + "/register",
+        headers={"Content-Type": "application/json"},
+        entity=json.dumps(asdict(info)).encode(),
+    ), timeout=10.0)
+    return resp.ok
+
+
+def list_services(registry_url: str, name: str) -> List[dict]:
+    resp = send_request(HTTPRequestData(
+        url=registry_url.rstrip("/") + f"/services/{name}", method="GET",
+    ), timeout=10.0)
+    return resp.json() if resp.ok else []

@@ -1,0 +1,285 @@
+"""Low-latency model serving: embedded HTTP servers + continuous batching.
+
+Reference: the Spark Serving subsystem (SURVEY §2.4/§3.4) —
+HTTPSourceV2.scala:114-735 (per-executor embedded `WorkerServer`, epoch
+request queues, `routingTable` correlating request-id -> held exchange,
+`historyQueues`/`recoveredPartitions` replay), HTTPSinkV2.scala:55-150
+(`replyTo` over the held socket), DistributedHTTPSource.scala (per-JVM shared
+server), DriverServiceUtils (:133-194, worker ServiceInfo registry).
+
+TPU-native redesign: one embedded server per host process feeds a
+continuous-batching loop — requests are drained into a columnar Table
+micro-batch, run through a (jit-compiled) Transformer, and answered over the
+held connections.  The data path never leaves the host that accepted the
+request (the reference's sub-ms claim rests on the same property).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Empty, Queue
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.schema import Table
+from ..io.http.schema import HTTPRequestData, HTTPResponseData
+
+__all__ = ["CachedRequest", "WorkerServer", "ServingServer", "ServiceInfo",
+           "parse_request", "make_reply"]
+
+
+@dataclass
+class ServiceInfo:
+    """What a worker reports to the registry (HTTPSourceV2 ServiceInfo)."""
+
+    name: str
+    host: str
+    port: int
+    path: str
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{self.path}"
+
+
+@dataclass
+class CachedRequest:
+    """A held exchange: the handler thread parks on `done` until the batch
+    loop replies (routingTable entry in the reference)."""
+
+    id: str
+    request: HTTPRequestData
+    done: threading.Event = field(default_factory=threading.Event)
+    response: Optional[HTTPResponseData] = None
+    attempts: int = 0
+
+
+class WorkerServer:
+    """Embedded threaded HTTP server with request queue + routing table.
+
+    Reference: HTTPSourceV2.scala WorkerServer (:475-696).
+    """
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
+                 path: str = "/", handler_timeout: float = 30.0):
+        self.name = name
+        self.path = path if path.startswith("/") else "/" + path
+        self.queue: "Queue[CachedRequest]" = Queue()
+        self.routing: Dict[str, CachedRequest] = {}
+        self._routing_lock = threading.Lock()
+        self.handler_timeout = handler_timeout
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path.rstrip("/") != outer.path.rstrip("/"):
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                req = CachedRequest(
+                    id=uuid.uuid4().hex,
+                    request=HTTPRequestData(
+                        url=self.path, method="POST",
+                        headers=dict(self.headers.items()), entity=body,
+                    ),
+                )
+                with outer._routing_lock:
+                    outer.routing[req.id] = req
+                outer.queue.put(req)
+                if not req.done.wait(outer.handler_timeout):
+                    outer._finish(req.id)
+                    self.send_error(504, "model timed out")
+                    return
+                resp = req.response or HTTPResponseData(500, "no response")
+                body = resp.entity or b""
+                self.send_response(resp.status_code)
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=f"serve-{name}", daemon=True
+        )
+
+    @property
+    def service_info(self) -> ServiceInfo:
+        h, p = self._httpd.server_address[:2]
+        return ServiceInfo(self.name, h, p, self.path)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def _finish(self, request_id: str):
+        with self._routing_lock:
+            self.routing.pop(request_id, None)
+
+    def get_batch(self, max_batch: int, timeout_ms: float) -> List[CachedRequest]:
+        """Drain up to max_batch requests; blocks up to timeout_ms for the
+        first one (continuous-batching feed)."""
+        out: List[CachedRequest] = []
+        try:
+            out.append(self.queue.get(timeout=timeout_ms / 1000.0))
+        except Empty:
+            return out
+        while len(out) < max_batch:
+            try:
+                out.append(self.queue.get_nowait())
+            except Empty:
+                break
+        return out
+
+    def requeue(self, req: CachedRequest):
+        """Replay a failed request (historyQueues/recoveredPartitions)."""
+        req.attempts += 1
+        self.queue.put(req)
+
+    def reply_to(self, request_id: str, response: HTTPResponseData):
+        """HTTPSinkV2 replyTo: answer over the held exchange."""
+        with self._routing_lock:
+            req = self.routing.pop(request_id, None)
+        if req is not None:
+            req.response = response
+            req.done.set()
+
+
+def parse_request(batch: List[CachedRequest],
+                  schema: Optional[List[str]] = None):
+    """JSON request bodies -> columnar micro-batch (IOImplicits.parseRequest).
+
+    Every body must be a JSON object; `schema` restricts/orders the columns.
+    Returns (table, id_col): the routing-id column name is chosen to never
+    collide with a body field (a client field named 'id' must not clobber
+    reply routing).
+    """
+    from ..core.schema import find_unused_column_name
+
+    rows = []
+    for req in batch:
+        try:
+            rows.append(json.loads(req.request.entity or b"{}"))
+        except json.JSONDecodeError:
+            rows.append({})
+    cols = schema or sorted({k for r in rows for k in r})
+    id_col = find_unused_column_name("request_id", cols)
+    data: Dict[str, Any] = {id_col: [r.id for r in batch]}
+    for c in cols:
+        vals = [r.get(c) for r in rows]
+        try:
+            data[c] = np.asarray(vals)
+            if data[c].dtype.kind in "OSU" and not all(
+                isinstance(v, str) for v in vals
+            ):
+                raise ValueError
+        except (ValueError, TypeError):
+            arr = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                arr[i] = v
+            data[c] = arr
+    return Table(data), id_col
+
+
+def make_reply(table: Table, reply_col: str, server: WorkerServer,
+               id_col: str = "request_id"):
+    """Answer every row's held exchange with the reply column as JSON
+    (IOImplicits.makeReply + HTTPSinkV2 write)."""
+    ids = table[id_col]
+    vals = table[reply_col]
+    for i in range(len(table)):
+        v = vals[i]
+        if isinstance(v, np.ndarray):
+            v = v.tolist()
+        elif isinstance(v, np.generic):
+            v = v.item()
+        body = json.dumps({reply_col: v}).encode("utf-8")
+        server.reply_to(
+            ids[i],
+            HTTPResponseData(200, "OK",
+                             {"Content-Type": "application/json"}, body),
+        )
+
+
+class ServingServer:
+    """Turn any Transformer into a web service with continuous batching.
+
+    Reference API surface: `spark.readStream.server(...).parseRequest ...
+    .makeReply(col).writeStream.server()` (IOImplicits.scala:22-199); here
+    the source-query-sink triple is one object.
+
+    model: a Transformer whose transform consumes the parsed request columns
+    and produces `reply_col`.
+    """
+
+    def __init__(self, model, reply_col: str, name: str = "serving",
+                 host: str = "127.0.0.1", port: int = 0, path: str = "/",
+                 input_schema: Optional[List[str]] = None,
+                 max_batch: int = 64, batch_timeout_ms: float = 10.0,
+                 max_attempts: int = 2):
+        self.model = model
+        self.reply_col = reply_col
+        self.input_schema = input_schema
+        self.max_batch = int(max_batch)
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.max_attempts = int(max_attempts)
+        self.server = WorkerServer(name, host, port, path)
+        self._running = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self.stats = {"requests": 0, "batches": 0, "errors": 0}
+
+    @property
+    def service_info(self) -> ServiceInfo:
+        return self.server.service_info
+
+    def _loop(self):
+        while self._running.is_set():
+            batch = self.server.get_batch(self.max_batch, self.batch_timeout_ms)
+            if not batch:
+                continue
+            try:
+                table, id_col = parse_request(batch, self.input_schema)
+                out = self.model.transform(table)
+                make_reply(out, self.reply_col, self.server, id_col=id_col)
+                self.stats["requests"] += len(batch)
+                self.stats["batches"] += 1
+            except Exception as e:  # noqa: BLE001 — serving must survive
+                self.stats["errors"] += 1
+                for req in batch:
+                    if req.attempts + 1 < self.max_attempts:
+                        self.server.requeue(req)
+                    else:
+                        self.server.reply_to(
+                            req.id,
+                            HTTPResponseData(
+                                500, "model error", {},
+                                json.dumps({"error": str(e)}).encode(),
+                            ),
+                        )
+
+    def start(self) -> ServiceInfo:
+        self.server.start()
+        self._running.set()
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-batch-loop")
+        self._worker.start()
+        return self.service_info
+
+    def stop(self):
+        self._running.clear()
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        self.server.stop()
