@@ -1,0 +1,175 @@
+"""Sequence parallelism: ring attention + Ulysses all-to-all attention.
+
+The reference has NO sequence parallelism (SURVEY §2.10: "Not present in
+reference" — its longest-sequence handling is CNTK dynamic axes); this module
+is the TPU-first upgrade that makes long-context first-class, following the
+blockwise-ring construction (Liu et al., Ring Attention) and the
+DeepSpeed-Ulysses head-scatter construction, both expressed as XLA
+collectives over the mesh:
+
+- ring_attention: K/V blocks rotate around the ICI ring via `ppermute` while
+  each device accumulates its queries' attention with a numerically-stable
+  online softmax — memory O(S/n) per device, compute fully overlapped.
+- ulysses_attention: `all_to_all` reshards (seq-sharded -> head-sharded),
+  runs dense per-head attention, and reshards back — cheaper at moderate S,
+  requires heads % n == 0.
+
+Both are exact: they match full attention to float tolerance.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["full_attention", "ring_attention", "ulysses_attention"]
+
+
+def full_attention(q, k, v, causal: bool = False):
+    """Reference dense attention.  q,k,v: (B, S, H, D) -> (B, S, H, D)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block_accumulate(q, k_blk, v_blk, o, m, l, q_off, k_off, causal: bool):
+    """Online-softmax accumulation of one K/V block into (o, m, l).
+
+    q: (B, Sq, H, D) local queries at global offset q_off;
+    k_blk/v_blk: (B, Sk, H, D) at global offset k_off.
+    o: (B, Sq, H, D) unnormalized; m,l: (B, H, Sq) running max / normalizer.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])
+        kpos = k_off + jnp.arange(k_blk.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)                      # (B, H, Sq)
+    m_new = jnp.maximum(m, m_blk)
+    # fully-masked blocks produce -inf maxima; keep exp() finite
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(s), s - m_safe[..., None], -jnp.inf))
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_blk
+    )
+    return o_new, m_new, l_new
+
+
+def _resolve_axis(mesh: Mesh, axis: Optional[str]) -> str:
+    """Default to the mesh's dedicated 'seq' axis when it is populated
+    (mesh.py reserves it for sequence parallelism); else fall back to
+    'data' so an all-data mesh still works."""
+    if axis is not None:
+        return axis
+    if mesh.shape.get("seq", 1) > 1:
+        return "seq"
+    return "data"
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,
+                   causal: bool = False):
+    """Exact attention with sequence sharded over `axis` (default: the
+    mesh's 'seq' axis if populated, else 'data').
+
+    q,k,v: (B, S, H, D) GLOBAL arrays (or already sharded); S must divide by
+    the axis size.  Returns (B, S, H, D) with the same sharding.
+    """
+    axis = _resolve_axis(mesh, axis)
+    n = mesh.shape[axis]
+    seq_spec = P(None, axis, None, None)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+        check_vma=False,
+    )
+    def ring(q_loc, k_loc, v_loc):
+        idx = jax.lax.axis_index(axis)
+        s_loc = q_loc.shape[1]
+        q_off = idx * s_loc
+        o = jnp.zeros(q_loc.shape, jnp.float32)
+        m = jnp.full(
+            (q_loc.shape[0], q_loc.shape[2], s_loc), -jnp.inf, jnp.float32
+        )
+        l = jnp.zeros((q_loc.shape[0], q_loc.shape[2], s_loc), jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(carry, r):
+            o, m, l, k_blk, v_blk = carry
+            # k/v block currently held came from device (idx - r) mod n
+            src = (idx - r) % n
+            k_off = src * s_loc
+            o, m, l = _block_accumulate(
+                q_loc, k_blk, v_blk, o, m, l, q_off, k_off, causal
+            )
+            # rotate: send our block to the next device in the ring
+            k_nxt = jax.lax.ppermute(k_blk, axis, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis, perm)
+            return (o, m, l, k_nxt, v_nxt), None
+
+        # n-1 rotations; the last held block is accumulated without a
+        # wasted final ppermute of the full K/V shard
+        (o, m, l, k_last, v_last), _ = jax.lax.scan(
+            step, (o, m, l, k_loc, v_loc), jnp.arange(n - 1)
+        )
+        o, m, l = _block_accumulate(
+            q_loc, k_last, v_last, o, m, l, q_off,
+            ((idx - (n - 1)) % n) * s_loc, causal,
+        )
+        return o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+
+    return ring(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,
+                      causal: bool = False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses construction).
+
+    Heads must divide by the axis size: reshard (S/n, H) -> (S, H/n), run
+    dense attention on full sequences per head shard, reshard back.
+    """
+    axis = _resolve_axis(mesh, axis)
+    n = mesh.shape[axis]
+    if q.shape[2] % n:
+        raise ValueError(f"heads {q.shape[2]} not divisible by axis size {n}")
+    seq_spec = P(None, axis, None, None)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+        check_vma=False,
+    )
+    def ulysses(q_loc, k_loc, v_loc):
+        def scatter_heads(x):
+            # (B, S/n, H, D) -> (B, S, H/n, D)
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def gather_seq(x):
+            # (B, S, H/n, D) -> (B, S/n, H, D)
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        qg, kg, vg = scatter_heads(q_loc), scatter_heads(k_loc), scatter_heads(v_loc)
+        og = full_attention(qg, kg, vg, causal=causal)
+        return gather_seq(og)
+
+    return ulysses(q, k, v)

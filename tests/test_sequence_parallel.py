@@ -1,0 +1,179 @@
+"""Sequence parallelism + BiLSTM suite: ring/Ulysses attention must be EXACT
+vs dense attention on the 8-device virtual mesh; the tagger must learn and
+round-trip.  (Reference has no sequence parallelism — SURVEY §2.10; this is
+the TPU-first long-context capability.)
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.bilstm import (
+    SequenceTagger,
+    bucket_length,
+    pad_to_buckets,
+)
+from mmlspark_tpu.parallel.mesh import make_mesh
+from mmlspark_tpu.parallel.ring_attention import (
+    full_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(data=8)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 64, 8, 16
+    mk = lambda: jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_ring_attention_matches_full(mesh, qkv):
+    q, k, v = qkv
+    expected = full_attention(q, k, v, causal=False)
+    got = ring_attention(q, k, v, mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_causal(mesh, qkv):
+    q, k, v = qkv
+    expected = full_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_matches_full(mesh, qkv):
+    q, k, v = qkv
+    expected = full_attention(q, k, v, causal=False)
+    got = ulysses_attention(q, k, v, mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_causal(mesh, qkv):
+    q, k, v = qkv
+    expected = full_attention(q, k, v, causal=True)
+    got = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_seq_axis_default_on_mixed_mesh(qkv):
+    """On a data=4, seq=2 mesh both attentions default to the seq axis."""
+    mixed = make_mesh(data=4, seq=2)
+    q, k, v = qkv
+    expected = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(ring_attention(q, k, v, mixed, causal=True)),
+        np.asarray(expected), atol=2e-5, rtol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ulysses_attention(q, k, v, mixed, causal=True)),
+        np.asarray(expected), atol=2e-5, rtol=2e-5,
+    )
+
+
+def test_ulysses_rejects_bad_heads(mesh):
+    x = jnp.zeros((1, 8, 3, 4))  # 3 heads not divisible by 8
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(x, x, x, mesh)
+
+
+def test_ring_attention_grad_flows(mesh, qkv):
+    q, k, v = qkv
+
+    def loss_ring(q):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_full(q):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_ring)(q)
+    g2 = jax.grad(loss_full)(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=5e-4, rtol=1e-3)
+
+
+# ------------------------------------------------------------------ buckets
+def test_bucket_length():
+    assert bucket_length(3) == 16
+    assert bucket_length(16) == 16
+    assert bucket_length(17) == 32
+    # beyond the last bucket: exact-length bucket, never truncation
+    assert bucket_length(9999) == 9999
+
+
+def test_long_sequence_not_truncated():
+    t = _toy_tagging_table(n=10, seed=2)
+    model = SequenceTagger(epochs=1, hidden=8, embed_dim=8,
+                           buckets=[16]).fit(t)
+    long_tokens = np.empty(1, dtype=object)
+    long_tokens[0] = ["alpha"] * 40  # longer than every bucket
+    out = model.transform(Table({"tokens": long_tokens}))
+    assert len(out["prediction"][0]) == 40
+
+
+def test_tagger_empty_fit_raises():
+    empty = np.empty(0, dtype=object)
+    with pytest.raises(ValueError, match="no training rows"):
+        SequenceTagger().fit(Table({"tokens": empty, "tags": empty}))
+
+
+def test_pad_to_buckets_groups():
+    seqs = [np.arange(5), np.arange(20), np.arange(10)]
+    groups = pad_to_buckets(seqs, (16, 32))
+    assert set(groups) == {16, 32}
+    ids16, lens16, rows16 = groups[16]
+    assert ids16.shape == (2, 16)
+    assert sorted(lens16.tolist()) == [5, 10]
+    assert set(rows16.tolist()) == {0, 2}
+
+
+# ------------------------------------------------------------------ tagger
+def _toy_tagging_table(n=60, seed=0):
+    """Tag = 'NUM' for digit tokens else 'WORD' — learnable from embeddings."""
+    rng = np.random.default_rng(seed)
+    words = ["alpha", "beta", "gamma", "delta", "one1", "two2", "three3"]
+    toks = np.empty(n, dtype=object)
+    tags = np.empty(n, dtype=object)
+    for i in range(n):
+        ln = int(rng.integers(3, 12))
+        row = [words[int(j)] for j in rng.integers(0, len(words), ln)]
+        toks[i] = row
+        tags[i] = ["NUM" if any(c.isdigit() for c in w) else "WORD"
+                   for w in row]
+    return Table({"tokens": toks, "tags": tags})
+
+
+def test_sequence_tagger_learns():
+    t = _toy_tagging_table()
+    model = SequenceTagger(epochs=60, hidden=32, embed_dim=16,
+                           learning_rate=3e-3, buckets=[16]).fit(t)
+    out = model.transform(t)
+    correct = total = 0
+    for pred, gold in zip(out["prediction"], t["tags"]):
+        for p, g in zip(pred, gold):
+            correct += p == g
+            total += 1
+    assert correct / total > 0.95, f"token accuracy {correct/total}"
+
+
+def test_sequence_tagger_oov_and_roundtrip():
+    from fuzzing import fuzz
+
+    t = _toy_tagging_table(n=30, seed=1)
+    model = SequenceTagger(epochs=2, hidden=16, embed_dim=8,
+                           buckets=[16]).fit(t)
+    unseen = Table({"tokens": np.array([["zzz", "one1"]], dtype=object)})
+    out = model.transform(unseen)
+    assert len(out["prediction"][0]) == 2
+    fuzz(SequenceTagger(epochs=1, hidden=8, embed_dim=8, buckets=[16]), t)
