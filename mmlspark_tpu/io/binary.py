@@ -1,0 +1,69 @@
+"""Binary/CSV file ingestion: files -> columnar Table.
+
+Reference: core io/binary/BinaryFileFormat.scala:112 (Hadoop-FS binary
+DataSource producing (path, bytes) rows with sampleRatio push-down) +
+BinaryFileReader.scala:20 (parallel read); CSV ingestion rides the native
+C++ parser (mmlspark_tpu/native) instead of the JVM CSV stack.
+
+This is THE binary reader; io/image.py's readers delegate here.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+import random
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.schema import Table
+
+__all__ = ["read_binary_files", "read_csv"]
+
+
+def read_binary_files(pattern: str, recursive: bool = True,
+                      sample_ratio: float = 1.0, seed: int = 0,
+                      num_threads: int = 8) -> Table:
+    """Read every file matching `pattern` into a Table(path, bytes).
+
+    `sample_ratio` subsamples the file list before any IO (the reference's
+    sampleRatio push-down); reads are thread-parallel.
+    """
+    files = sorted(
+        f for f in _glob.glob(pattern, recursive=recursive)
+        if os.path.isfile(f)
+    )
+    if sample_ratio < 1.0:
+        rng = random.Random(seed)
+        files = [f for f in files if rng.random() < sample_ratio]
+
+    def read(f):
+        with open(f, "rb") as fh:
+            return fh.read()
+
+    with ThreadPoolExecutor(max_workers=num_threads) as pool:
+        blobs = list(pool.map(read, files))
+    data = np.empty(len(files), dtype=object)
+    for i, b in enumerate(blobs):
+        data[i] = b
+    return Table({"path": np.array(files, dtype=object), "bytes": data})
+
+
+def read_csv(path: str, has_header: bool = True,
+             column_names: Optional[Sequence[str]] = None) -> Table:
+    """Numeric CSV -> Table via the native C++ parser (NumPy fallback)."""
+    from .. import native
+
+    mat = native.load_csv_numeric(path, has_header=has_header)
+    if column_names is None:
+        if has_header:
+            with open(path) as f:
+                column_names = f.readline().strip().split(",")
+        else:
+            column_names = [f"c{i}" for i in range(mat.shape[1])]
+    if len(column_names) != mat.shape[1]:
+        raise ValueError(
+            f"{len(column_names)} names for {mat.shape[1]} columns"
+        )
+    return Table({name: mat[:, i] for i, name in enumerate(column_names)})

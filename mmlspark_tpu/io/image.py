@@ -88,15 +88,13 @@ def encode_image_row(row: Dict[str, Any], fmt: str = "PNG") -> bytes:
     return buf.getvalue()
 
 
-def read_binary_files(pattern: str, recursive: bool = True) -> Table:
-    """(path, bytes) table from a glob — BinaryFileFormat analog
-    (io/binary/BinaryFileFormat.scala:112, BinaryFileReader.scala:20)."""
-    paths = sorted(p for p in _glob.glob(pattern, recursive=recursive) if os.path.isfile(p))
-    values: List[bytes] = []
-    for p in paths:
-        with open(p, "rb") as f:
-            values.append(f.read())
-    return Table({"path": paths, "bytes": values})
+def read_binary_files(pattern: str, recursive: bool = True,
+                      sample_ratio: float = 1.0) -> Table:
+    """(path, bytes) table from a glob — BinaryFileFormat analog; delegates
+    to the canonical threaded reader in io/binary.py."""
+    from .binary import read_binary_files as _impl
+
+    return _impl(pattern, recursive=recursive, sample_ratio=sample_ratio)
 
 
 def read_image_dir(pattern: str, drop_invalid: bool = True) -> Table:

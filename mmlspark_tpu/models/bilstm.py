@@ -117,6 +117,12 @@ class SequenceTagger(Estimator):
             raise ValueError("SequenceTagger.fit: no training rows")
         token_lists = [list(map(str, t)) for t in table[self.tokens_col]]
         tag_lists = [list(map(str, t)) for t in table[self.tags_col]]
+        for i, (toks, tags) in enumerate(zip(token_lists, tag_lists)):
+            if len(toks) != len(tags):
+                raise ValueError(
+                    f"row {i}: {len(toks)} tokens but {len(tags)} tags — "
+                    "token/tag lists must align"
+                )
         vocab = {"<pad>": 0, "<unk>": 1}
         for toks in token_lists:
             for t in toks:
@@ -206,15 +212,22 @@ class SequenceTaggerModel(Model):
         if not id_seqs:
             return table.with_column(self.prediction_col, out)
 
-        @jax.jit
-        def predict(ids, lens):
-            logits = module.apply({"params": self.model_params}, ids, lens)
-            return jnp.argmax(logits, axis=-1)
+        # jit once per model instance (params passed as an argument), so
+        # repeated transform() calls reuse the per-bucket compile cache
+        if not hasattr(self, "_jit_predict"):
+            @jax.jit
+            def predict(params, ids, lens):
+                logits = module.apply({"params": params}, ids, lens)
+                return jnp.argmax(logits, axis=-1)
+
+            self._jit_predict = predict
 
         for b, (ids, lens, rows) in pad_to_buckets(
             id_seqs, tuple(self.buckets)
         ).items():
-            preds = np.asarray(predict(jnp.asarray(ids), jnp.asarray(lens)))
+            preds = np.asarray(self._jit_predict(
+                self.model_params, jnp.asarray(ids), jnp.asarray(lens)
+            ))
             for j, r in enumerate(rows):
                 n = int(lens[j])
                 out[r] = [inv_tags[int(p)] for p in preds[j, :n]]

@@ -1,0 +1,121 @@
+"""io extras suite: binary reader sampling/threading, native CSV Table,
+PowerBI writer, plot data helpers.
+"""
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.io.binary import read_binary_files, read_csv
+from mmlspark_tpu.io.powerbi import write_to_power_bi
+from mmlspark_tpu.plot import confusion_matrix_data, plot_feature_importances
+
+
+@pytest.fixture
+def file_tree(tmp_path):
+    for i in range(20):
+        sub = tmp_path / f"d{i % 3}"
+        sub.mkdir(exist_ok=True)
+        (sub / f"f{i}.bin").write_bytes(bytes([i]) * (i + 1))
+    return tmp_path
+
+
+def test_read_binary_files(file_tree):
+    t = read_binary_files(str(file_tree / "**" / "*.bin"))
+    assert len(t) == 20
+    i = list(t["path"]).index(str(file_tree / "d0" / "f0.bin"))
+    assert t["bytes"][i] == b"\x00"
+
+
+def test_read_binary_files_sampling(file_tree):
+    t = read_binary_files(str(file_tree / "**" / "*.bin"), sample_ratio=0.4,
+                          seed=1)
+    assert 0 < len(t) < 20
+
+
+def test_read_csv_native(tmp_path):
+    path = str(tmp_path / "m.csv")
+    with open(path, "w") as f:
+        f.write("a,b\n1,2.5\n3,4.5\n")
+    t = read_csv(path)
+    assert t.column_names == ["a", "b"]
+    np.testing.assert_allclose(t["b"], [2.5, 4.5])
+
+
+def test_power_bi_writer():
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = httpd.server_address[:2]
+    try:
+        t = Table({"x": np.arange(7), "name": [f"r{i}" for i in range(7)]})
+        written = write_to_power_bi(t, f"http://{host}:{port}/", batch_size=3)
+        assert written == 7
+        assert len(received) == 3  # 3+3+1
+        assert received[0][0] == {"x": 0, "name": "r0"}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_confusion_matrix_data():
+    cm, classes = confusion_matrix_data([0, 0, 1, 2], [0, 1, 1, 2])
+    assert classes.tolist() == [0, 1, 2]
+    assert cm[0, 0] == 1 and cm[0, 1] == 1 and cm[1, 1] == 1 and cm[2, 2] == 1
+
+
+def test_plot_feature_importances_order():
+    order, _ = plot_feature_importances([0.1, 0.9, 0.5], ["a", "b", "c"],
+                                        top_k=2)
+    assert order.tolist() == [1, 2]
+
+
+def test_read_csv_rejects_non_numeric(tmp_path):
+    path = str(tmp_path / "bad.csv")
+    with open(path, "w") as f:
+        f.write("a,b\n1,n/a\n")
+    with pytest.raises(ValueError):
+        read_csv(path)
+
+
+def test_power_bi_nan_becomes_null():
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = httpd.server_address[:2]
+    try:
+        t = Table({"x": np.array([1.0, np.nan, np.inf])})
+        assert write_to_power_bi(t, f"http://{host}:{port}/") == 3
+        assert received[0][1] == {"x": None}
+        assert received[0][2] == {"x": None}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -177,3 +177,10 @@ def test_sequence_tagger_oov_and_roundtrip():
     out = model.transform(unseen)
     assert len(out["prediction"][0]) == 2
     fuzz(SequenceTagger(epochs=1, hidden=8, embed_dim=8, buckets=[16]), t)
+
+
+def test_tagger_mismatched_lengths_raise():
+    toks = np.empty(1, dtype=object); toks[0] = ["a", "b", "c"]
+    tags = np.empty(1, dtype=object); tags[0] = ["X"]
+    with pytest.raises(ValueError, match="must align"):
+        SequenceTagger().fit(Table({"tokens": toks, "tags": tags}))
