@@ -1,0 +1,4 @@
+"""Binding autogenerator (reference: core codegen/, L7)."""
+from .generate import camel, generate_tests, generate_wrappers
+
+__all__ = ["generate_wrappers", "generate_tests", "camel"]

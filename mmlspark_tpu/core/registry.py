@@ -20,6 +20,7 @@ STAGE_MODULES = [
     "mmlspark_tpu.ops.image_stages",
     "mmlspark_tpu.models.tpu_model",
     "mmlspark_tpu.models.image_featurizer",
+    "mmlspark_tpu.models.bilstm",
     "mmlspark_tpu.featurize.featurize",
     "mmlspark_tpu.featurize.value_indexer",
     "mmlspark_tpu.featurize.clean_missing",
@@ -28,16 +29,18 @@ STAGE_MODULES = [
     "mmlspark_tpu.models.train_classifier",
     "mmlspark_tpu.models.statistics",
     "mmlspark_tpu.gbdt.estimators",
-    "mmlspark_tpu.vw.estimators",
-    "mmlspark_tpu.vw.featurizer",
-    "mmlspark_tpu.automl.tuning",
+    "mmlspark_tpu.online.learners",
+    "mmlspark_tpu.online.featurizer",
+    "mmlspark_tpu.online.contextual_bandit",
+    "mmlspark_tpu.automl.tune",
     "mmlspark_tpu.automl.find_best",
-    "mmlspark_tpu.explainers.stages",
+    "mmlspark_tpu.explainers",
     "mmlspark_tpu.nn.knn",
-    "mmlspark_tpu.recommendation.sar",
-    "mmlspark_tpu.isolation_forest",
-    "mmlspark_tpu.io.http_stages",
-    "mmlspark_tpu.cognitive.services",
+    "mmlspark_tpu.recommendation",
+    "mmlspark_tpu.isolationforest",
+    "mmlspark_tpu.io.http.transformers",
+    "mmlspark_tpu.cognitive",
+    "mmlspark_tpu.cyber",
 ]
 
 

@@ -1,0 +1,29 @@
+"""CyberML: collaborative-filtering access-anomaly detection + feature prep.
+
+Reference: core/src/main/python/mmlspark/cyber/ (~1.7k LoC Py:
+anomaly/collaborative_filtering.py AccessAnomaly, complement_access.py,
+feature/ partitioned scalers and indexers).
+"""
+from .access_anomaly import (
+    AccessAnomaly,
+    AccessAnomalyModel,
+    ComplementAccessTransformer,
+)
+from .feature import (
+    IdIndexer,
+    IdIndexerModel,
+    PartitionedMinMaxScaler,
+    PartitionedScalerModel,
+    PartitionedStandardScaler,
+)
+
+__all__ = [
+    "AccessAnomaly",
+    "AccessAnomalyModel",
+    "ComplementAccessTransformer",
+    "IdIndexer",
+    "IdIndexerModel",
+    "PartitionedStandardScaler",
+    "PartitionedMinMaxScaler",
+    "PartitionedScalerModel",
+]

@@ -306,7 +306,8 @@ class GBDTRankerModel(_GBDTModelBase):
         return table.with_column(self.prediction_col, self.booster._raw_scores(x))
 
 
-# Drop-in aliases for reference users.
-LightGBMClassifier = GBDTClassifier
-LightGBMRegressor = GBDTRegressor
-LightGBMRanker = GBDTRanker
+# Drop-in aliases for reference users — registered under both names so
+# registry lookups (and generated bindings) resolve the reference names too.
+LightGBMClassifier = register_stage(GBDTClassifier, name="LightGBMClassifier")
+LightGBMRegressor = register_stage(GBDTRegressor, name="LightGBMRegressor")
+LightGBMRanker = register_stage(GBDTRanker, name="LightGBMRanker")

@@ -13,7 +13,7 @@ import glob as _glob
 import os
 import random
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

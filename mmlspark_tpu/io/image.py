@@ -10,9 +10,7 @@ HWC uint8 ndarray in **BGR** channel order (OpenCV/Spark convention).
 """
 from __future__ import annotations
 
-import glob as _glob
 import io as _io
-import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np

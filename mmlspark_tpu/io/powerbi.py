@@ -26,7 +26,11 @@ def write_to_power_bi(table: Table, url: str, batch_size: int = 100,
     """
     def jsonable(v):
         if isinstance(v, np.ndarray):
-            return [jsonable(x) for x in v.tolist()]
+            v = v.tolist()
+        if isinstance(v, (list, tuple)):
+            return [jsonable(x) for x in v]
+        if isinstance(v, dict):
+            return {k: jsonable(x) for k, x in v.items()}
         if isinstance(v, np.generic):
             v = v.item()
         # bare NaN/Infinity are invalid JSON — the endpoint would 400

@@ -123,17 +123,18 @@ int64_t csv_parse(const char* path, int has_header, double* out,
                   int64_t max_vals) {
     FILE* f = std::fopen(path, "rb");
     if (!f) return -1;
-    char line[1 << 16];
+    char* line = nullptr;
+    size_t cap = 0;  // getline grows the buffer: arbitrary line width
     int64_t written = 0, line_no = 0;
-    while (std::fgets(line, sizeof(line), f)) {
+    while (getline(&line, &cap, f) != -1) {
         if (line_no++ < has_header) continue;
         char* p = line;
         if (*p == '\n' || *p == '\0') continue;
         while (true) {
             char* end = nullptr;
             double v = std::strtod(p, &end);
-            if (written >= max_vals) { std::fclose(f); return -3; }
-            if (end == p) { std::fclose(f); return -4; }  // unparseable cell
+            if (written >= max_vals) { std::free(line); std::fclose(f); return -3; }
+            if (end == p) { std::free(line); std::fclose(f); return -4; }
             out[written++] = v;
             p = end;
             while (*p && *p != ',' && *p != '\n') p++;
@@ -141,6 +142,7 @@ int64_t csv_parse(const char* path, int has_header, double* out,
             p++;
         }
     }
+    std::free(line);
     std::fclose(f);
     return written;
 }

@@ -1,0 +1,123 @@
+"""Cyber suite — reference: core/src/test/python/mmlsparktest/cyber/
+(anomaly + feature tests): anomalous cross-group access must out-score
+in-group access; scalers are per-partition.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.cyber import (
+    AccessAnomaly,
+    ComplementAccessTransformer,
+    IdIndexer,
+    PartitionedMinMaxScaler,
+    PartitionedStandardScaler,
+)
+
+
+def _access_table(n_groups=3, users_per=8, res_per=6, events=40, seed=0):
+    """Users access only their own group's resources."""
+    rng = np.random.default_rng(seed)
+    rows_u, rows_r = [], []
+    for g in range(n_groups):
+        for _ in range(events):
+            rows_u.append(g * users_per + int(rng.integers(users_per)))
+            rows_r.append(g * res_per + int(rng.integers(res_per)))
+    return Table({
+        "user": np.asarray(rows_u, np.int64),
+        "res": np.asarray(rows_r, np.int64),
+    })
+
+
+def test_access_anomaly_cross_group_scores_higher():
+    t = _access_table()
+    model = AccessAnomaly(rank=6, max_iter=8, seed=1).fit(t)
+    # in-group (seen-ish) pairs vs cross-group (never seen) pairs
+    in_group = Table({
+        "user": np.asarray([0, 1, 9, 17], np.int64),
+        "res": np.asarray([0, 3, 7, 13], np.int64),
+    })
+    cross_group = Table({
+        "user": np.asarray([0, 1, 9, 17], np.int64),
+        "res": np.asarray([13, 16, 1, 2], np.int64),
+    })
+    s_in = model.transform(in_group)["anomaly_score"]
+    s_cross = model.transform(cross_group)["anomaly_score"]
+    assert s_cross.mean() > s_in.mean() + 0.5, (s_in, s_cross)
+
+
+def test_access_anomaly_multi_tenant():
+    t1 = _access_table(seed=2)
+    t2 = _access_table(seed=3)
+    t = Table({
+        "tenant": np.concatenate([np.zeros(len(t1), np.int64),
+                                  np.ones(len(t2), np.int64)]),
+        "user": np.concatenate([t1["user"], t2["user"]]),
+        "res": np.concatenate([t1["res"], t2["res"]]),
+    })
+    model = AccessAnomaly(tenant_col="tenant", rank=4, max_iter=5).fit(t)
+    out = model.transform(t)
+    assert np.all(np.isfinite(out["anomaly_score"]))
+    assert set(model.factors) == {0, 1}
+
+
+def test_complement_transformer():
+    t = _access_table(n_groups=1, users_per=5, res_per=5, events=10, seed=4)
+    comp = ComplementAccessTransformer(complement_ratio=1.0, seed=5).transform(t)
+    assert len(comp) > 0
+    seen = set(zip(t["user"].tolist(), t["res"].tolist()))
+    for u, r in zip(comp["user"], comp["res"]):
+        assert (int(u), int(r)) not in seen
+
+
+def test_complement_budget_exhausted():
+    # 2x2 grid fully observed -> no complement possible
+    t = Table({
+        "user": np.asarray([0, 0, 1, 1], np.int64),
+        "res": np.asarray([0, 1, 0, 1], np.int64),
+    })
+    comp = ComplementAccessTransformer(complement_ratio=2.0).transform(t)
+    assert len(comp) == 0
+
+
+def test_id_indexer_per_tenant():
+    t = Table({
+        "tenant": np.asarray([0, 0, 1, 1], np.int64),
+        "user": ["alice", "bob", "alice", "carol"],
+    })
+    model = IdIndexer(input_col="user", partition_key="tenant",
+                      output_col="uidx").fit(t)
+    out = model.transform(t)
+    # per-tenant contiguous: both tenants start at 0
+    assert out["uidx"][0] == 0 and out["uidx"][2] == 0
+    assert model.partition_size(0) == 2 and model.partition_size(1) == 2
+
+
+def test_partitioned_standard_scaler():
+    t = Table({
+        "tenant": np.asarray([0, 0, 0, 1, 1, 1], np.int64),
+        "value": np.asarray([1.0, 2.0, 3.0, 100.0, 200.0, 300.0]),
+    })
+    model = PartitionedStandardScaler(
+        input_col="value", partition_key="tenant", output_col="scaled"
+    ).fit(t)
+    out = model.transform(t)
+    # each partition independently standardized -> same scaled values
+    np.testing.assert_allclose(out["scaled"][:3], out["scaled"][3:], atol=1e-9)
+    assert abs(out["scaled"][:3].mean()) < 1e-9
+
+
+def test_partitioned_minmax_scaler():
+    t = Table({
+        "value": np.asarray([5.0, 10.0, 15.0]),
+    })
+    out = PartitionedMinMaxScaler(input_col="value",
+                                  output_col="scaled").fit(t).transform(t)
+    np.testing.assert_allclose(out["scaled"], [0.0, 0.5, 1.0])
+
+
+def test_cyber_roundtrip():
+    from fuzzing import fuzz
+
+    t = _access_table(n_groups=2, users_per=4, res_per=4, events=15, seed=6)
+    fuzz(AccessAnomaly(rank=3, max_iter=3), t)
