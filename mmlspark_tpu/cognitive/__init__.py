@@ -15,6 +15,7 @@ from .services import (
     Detect,
     DetectAnomalies,
     DetectLastAnomaly,
+    DocumentTranslator,
     SpeechToText,
     Translate,
     Transliterate,
@@ -72,6 +73,7 @@ __all__ = [
     "Transliterate",
     "AnalyzeLayout",
     "AnalyzeInvoices",
+    "DocumentTranslator",
     "BingImageSearch",
     "AzureSearchWriter",
 ]

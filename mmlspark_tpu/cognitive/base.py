@@ -151,6 +151,7 @@ class BasicAsyncReply(CognitiveServicesBase):
                 status = str(poll.json().get("status", "")).lower()
             except (ValueError, json.JSONDecodeError):
                 return poll
-            if status in ("succeeded", "failed", "partiallycompleted"):
+            if status in ("succeeded", "failed", "partiallycompleted",
+                          "cancelled", "validationfailed"):
                 return poll
         return HTTPResponseData(408, "async operation polling exhausted")

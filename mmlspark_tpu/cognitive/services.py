@@ -26,6 +26,7 @@ __all__ = [
     "Transliterate",
     "AnalyzeLayout",
     "AnalyzeInvoices",
+    "DocumentTranslator",
     "BingImageSearch",
 ]
 
@@ -163,6 +164,28 @@ class AnalyzeLayout(_FormRecognizerBase):
 @register_stage
 class AnalyzeInvoices(_FormRecognizerBase):
     _path = "/formrecognizer/v2.1/prebuilt/invoice/analyze"
+
+
+@register_stage
+class DocumentTranslator(BasicAsyncReply):
+    """Batch document translation: POST a batches spec, poll the operation
+    (reference cognitive/DocumentTranslator.scala, 151 LoC)."""
+
+    _path = "/translator/text/batch/v1.0/batches"
+    service_name = Param("translator resource name", default="")
+    inputs_col = Param("column of batch-input dicts "
+                       "(sourceUrl/targets per the service spec)",
+                       default="batches")
+
+    def _base_url(self) -> str:
+        if self.url:
+            return self.url
+        return (f"https://{self.service_name}.cognitiveservices.azure.com"
+                f"{self._path}")
+
+    def _prepare_entity(self, table, i):
+        v = table[self.inputs_col][i]
+        return None if v is None else json.dumps({"inputs": v}).encode()
 
 
 @register_stage

@@ -59,20 +59,36 @@ class ComplementAccessTransformer(Transformer):
             n_users = users[m].max() + 1
             n_res = ress[m].max() + 1
             want = int(m.sum() * float(self.complement_ratio))
-            budget = n_users * n_res - len(seen)
+            grid = n_users * n_res
+            budget = grid - len(seen)
             want = min(want, max(budget, 0))
-            got = 0
-            attempts = 0
-            while got < want and attempts < 50 * max(want, 1):
-                u = int(rng.integers(n_users))
-                r = int(rng.integers(n_res))
-                attempts += 1
-                if (u, r) not in seen:
-                    seen.add((u, r))
+            # dense access matrices defeat rejection sampling; enumerate the
+            # complement exactly when unseen pairs are scarce
+            if budget <= 4 * want or budget < 0.05 * grid:
+                all_keys = np.arange(grid, dtype=np.int64)
+                seen_keys = np.fromiter(
+                    (u * n_res + r for u, r in seen), np.int64, len(seen)
+                )
+                unseen = np.setdiff1d(all_keys, seen_keys,
+                                      assume_unique=False)
+                pick = rng.choice(len(unseen), size=want, replace=False)
+                for key in unseen[pick]:
                     out_t.append(t)
-                    out_u.append(u)
-                    out_r.append(r)
-                    got += 1
+                    out_u.append(int(key // n_res))
+                    out_r.append(int(key % n_res))
+            else:
+                got = 0
+                attempts = 0
+                while got < want and attempts < 50 * max(want, 1):
+                    u = int(rng.integers(n_users))
+                    r = int(rng.integers(n_res))
+                    attempts += 1
+                    if (u, r) not in seen:
+                        seen.add((u, r))
+                        out_t.append(t)
+                        out_u.append(u)
+                        out_r.append(r)
+                        got += 1
         data = {
             self.user_col: np.asarray(out_u, np.int64),
             self.res_col: np.asarray(out_r, np.int64),

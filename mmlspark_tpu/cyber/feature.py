@@ -128,7 +128,8 @@ class PartitionedScalerModel(Model):
         vals = np.asarray(table[self.input_col], np.float64)
         out = np.zeros(len(table), np.float64)
         stats = self.stats
-        for i, k in enumerate(keys):
+        for k in np.unique(keys):  # one vectorized op per partition
             shift, scale = stats.get(k, (0.0, 1.0))
-            out[i] = (vals[i] - shift) / scale
+            mask = keys == k
+            out[mask] = (vals[mask] - shift) / scale
         return table.with_column(self.output_col, out)

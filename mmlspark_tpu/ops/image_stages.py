@@ -195,13 +195,24 @@ class ResizeImageTransformer(_BatchedImageStage):
 @register_stage
 class UnrollImage(_BatchedImageStage):
     """Image rows -> flat CHW float vector column
-    (core/image/UnrollImage.scala:30-55: unsigned-byte fix + c*h*w layout)."""
+    (core/image/UnrollImage.scala:30-55: unsigned-byte fix + c*h*w layout).
+
+    The unroll (+ optional per-channel normalize) runs as the fused Pallas
+    kernel (ops/pallas_kernels.py) — one HBM round-trip per image."""
 
     input_col = Param("image column", default="image")
     output_col = Param("vector column", default="unrolled")
+    mean = Param("per-channel mean to subtract", default=None,
+                 converter=TypeConverters.to_list_float)
+    std = Param("per-channel std to divide", default=None,
+                converter=TypeConverters.to_list_float)
 
     def _pipeline_fn(self):
-        return I.hwc_to_chw_flat
+        from .pallas_kernels import fused_normalize_unroll
+
+        mean = self.get_or_default("mean") or (0.0,)
+        std = self.get_or_default("std") or (1.0,)
+        return lambda batch: fused_normalize_unroll(batch, mean, std)
 
     def _emit(self, out_batch, src_rows):
         return [np.asarray(v, dtype=np.float64) for v in out_batch]

@@ -1,0 +1,107 @@
+"""Benchmark regression tests against committed metric CSVs.
+
+Reference: core test/benchmarks/Benchmarks.scala:16-80 — metrics compared to
+committed CSVs with (name, value, precision, higherIsBetter) semantics;
+e.g. lightgbm benchmarks_VerifyLightGBMClassifier.csv (AUC per boosting
+mode, SURVEY §4.4 / §6).
+"""
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.gbdt.estimators import GBDTClassifier, GBDTRegressor
+from mmlspark_tpu.models.statistics import roc_auc
+
+BENCH_DIR = os.path.join(os.path.dirname(__file__), "benchmarks")
+
+
+def load_benchmarks(filename):
+    with open(os.path.join(BENCH_DIR, filename)) as f:
+        return {
+            row["name"]: (
+                float(row["value"]), float(row["precision"]),
+                row["higherIsBetter"] == "1",
+            )
+            for row in csv.DictReader(f)
+        }
+
+
+def assert_benchmark(benchmarks, name, value):
+    """Reference semantics (Benchmarks.scala): a metric may beat the
+    committed value but must not regress beyond `precision`."""
+    expected, precision, higher_better = benchmarks[name]
+    if higher_better:
+        assert value >= expected - precision, (
+            f"{name}: {value:.4f} regressed below {expected:.4f} - {precision}"
+        )
+    else:
+        assert value <= expected + precision, (
+            f"{name}: {value:.4f} regressed above {expected:.4f} + {precision}"
+        )
+
+
+def _cls_data(seed=7, n=400, d=8):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logits = (x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+              + 0.3 * rng.normal(size=n))
+    return Table({"features": x, "label": (logits > 0).astype(np.int64)})
+
+
+def _reg_data(seed=8, n=400, d=8):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = x[:, 0] * 2 + np.sin(x[:, 1] * 2) + 0.1 * rng.normal(size=n)
+    return Table({"features": x, "label": y.astype(np.float64)})
+
+
+MODES = ["gbdt", "rf", "dart", "goss"]
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_classifier_auc_benchmark(mode):
+    benchmarks = load_benchmarks("benchmarks_gbdt_classifier.csv")
+    t = _cls_data()
+    tr, te = t.slice(0, 300), t.slice(300)
+    m = GBDTClassifier(
+        num_iterations=50, num_leaves=15, boosting_type=mode, seed=0,
+        bagging_fraction=0.8 if mode == "rf" else 1.0,
+        bagging_freq=1 if mode == "rf" else 0,
+    ).fit(tr)
+    probs = m.transform(te)["probability"]
+    p1 = (
+        np.asarray([np.asarray(v).ravel()[-1] for v in probs])
+        if probs.dtype == object else np.asarray(probs)[:, 1]
+    )
+    auc = roc_auc(np.asarray(te["label"]), p1)
+    assert_benchmark(benchmarks, f"auc_{mode}", auc)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_regressor_l2_benchmark(mode):
+    benchmarks = load_benchmarks("benchmarks_gbdt_regressor.csv")
+    t = _reg_data()
+    tr, te = t.slice(0, 300), t.slice(300)
+    m = GBDTRegressor(
+        num_iterations=50, num_leaves=15, boosting_type=mode, seed=0,
+        bagging_fraction=0.8 if mode == "rf" else 1.0,
+        bagging_freq=1 if mode == "rf" else 0,
+    ).fit(tr)
+    pred = m.transform(te)["prediction"]
+    l2 = float(np.mean((pred - te["label"]) ** 2))
+    assert_benchmark(benchmarks, f"l2_{mode}", l2)
+
+
+def test_assert_benchmark_semantics():
+    b = {"m_hi": (0.9, 0.05, True), "m_lo": (1.0, 0.1, False)}
+    assert_benchmark(b, "m_hi", 0.86)   # within tolerance
+    assert_benchmark(b, "m_hi", 0.99)   # beating is fine
+    assert_benchmark(b, "m_lo", 1.05)
+    assert_benchmark(b, "m_lo", 0.2)    # beating is fine
+    with pytest.raises(AssertionError):
+        assert_benchmark(b, "m_hi", 0.80)
+    with pytest.raises(AssertionError):
+        assert_benchmark(b, "m_lo", 1.2)

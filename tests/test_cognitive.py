@@ -269,3 +269,12 @@ def test_cognitive_roundtrip(mock_url):
         url=f"{mock_url}/text/analytics/v3.0/sentiment", subscription_key="k",
     )
     fuzz_transformer(stage, t)
+
+
+def test_document_translator_registered():
+    from mmlspark_tpu.cognitive import DocumentTranslator
+    from mmlspark_tpu.core.registry import get_stage_class
+
+    assert get_stage_class("DocumentTranslator") is DocumentTranslator
+    stage = DocumentTranslator(service_name="acct")
+    assert "acct.cognitiveservices.azure.com" in stage._base_url()

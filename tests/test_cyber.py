@@ -121,3 +121,16 @@ def test_cyber_roundtrip():
 
     t = _access_table(n_groups=2, users_per=4, res_per=4, events=15, seed=6)
     fuzz(AccessAnomaly(rank=3, max_iter=3), t)
+
+
+def test_complement_dense_grid_enumerates():
+    """Rejection sampling must not starve on dense access matrices."""
+    users, ress = np.meshgrid(np.arange(10), np.arange(10))
+    mask = np.ones(100, bool)
+    mask[[5, 37, 61, 88]] = False  # leave exactly 4 unseen pairs
+    t = Table({
+        "user": users.ravel()[mask].astype(np.int64),
+        "res": ress.ravel()[mask].astype(np.int64),
+    })
+    comp = ComplementAccessTransformer(complement_ratio=1.0, seed=9).transform(t)
+    assert len(comp) == 4  # found ALL unseen pairs despite 96% density

@@ -274,3 +274,15 @@ def test_registry_roundtrip():
             srv.stop()
     finally:
         reg.stop()
+
+
+def test_port_forwarding_command():
+    from mmlspark_tpu.serving.port_forwarding import forwarding_command
+
+    cmd = forwarding_command("bastion.example.com", 8080, 5000,
+                             user="svc", key_file="/k.pem")
+    assert cmd[0] == "ssh" and "-R" in cmd
+    assert "8080:127.0.0.1:5000" in cmd
+    assert cmd[-1] == "svc@bastion.example.com"
+    cmd2 = forwarding_command("h", 9000, 5001, reverse=False)
+    assert "-L" in cmd2 and "5001:127.0.0.1:9000" in cmd2

@@ -142,3 +142,33 @@ class TestImageStages:
     def test_augmenter_doubles_rows(self, img_table):
         out = ImageSetAugmenter().transform(img_table)
         assert out.num_rows == 12
+
+
+def test_pallas_fused_normalize_unroll_matches_xla():
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.ops.image import hwc_to_chw_flat, normalize
+    from mmlspark_tpu.ops.pallas_kernels import fused_normalize_unroll
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.random((3, 24, 24, 3)).astype(np.float32))
+    got = fused_normalize_unroll(x, (0.5, 0.4, 0.3), (0.2, 0.3, 0.4))
+    ref = hwc_to_chw_flat(normalize(x, (0.5, 0.4, 0.3), (0.2, 0.3, 0.4)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+def test_unroll_image_stage_with_normalization():
+    from mmlspark_tpu.ops.image_stages import UnrollImage
+    from mmlspark_tpu.io.image import array_to_image_row
+
+    rng = np.random.default_rng(12)
+    rows = np.empty(2, dtype=object)
+    for i in range(2):
+        rows[i] = array_to_image_row(
+            (rng.random((8, 8, 3)) * 255).astype(np.uint8)
+        )
+    t = Table({"image": rows})
+    out = UnrollImage(mean=[127.5, 127.5, 127.5], std=[255.0, 255.0, 255.0]).transform(t)
+    v = out["unrolled"][0]
+    assert v.shape == (8 * 8 * 3,)
+    assert -0.5 <= v.min() and v.max() <= 0.5
