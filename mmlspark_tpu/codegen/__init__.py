@@ -1,4 +1,5 @@
 """Binding autogenerator (reference: core codegen/, L7)."""
-from .generate import camel, generate_tests, generate_wrappers
+from .generate import camel, generate_r_wrappers, generate_tests, generate_wrappers
 
-__all__ = ["generate_wrappers", "generate_tests", "camel"]
+__all__ = ["generate_wrappers", "generate_tests", "generate_r_wrappers",
+           "camel"]

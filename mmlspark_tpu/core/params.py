@@ -74,11 +74,15 @@ class Param(Generic[T]):
         doc: str = "",
         default: Any = _REQUIRED,
         converter: Optional[Callable[[Any], T]] = None,
+        transient: bool = False,
     ):
         self.doc = doc
         self.has_default = default is not Param._REQUIRED
         self.default = None if not self.has_default else default
         self.converter = converter or TypeConverters.identity
+        #: transient params are runtime-only hooks (delegates, live clients):
+        #: skipped on save/load and excluded from round-trip equality
+        self.transient = transient
         self.name: str = ""  # filled by __set_name__
 
     def __set_name__(self, owner, name):
@@ -224,4 +228,7 @@ class Params:
         }
 
     def complex_param_values(self) -> Dict[str, Any]:
-        return {n: v for n, v in self._param_map.items() if self.param(n).is_complex}
+        return {
+            n: v for n, v in self._param_map.items()
+            if self.param(n).is_complex and not self.param(n).transient
+        }

@@ -235,6 +235,7 @@ class Booster:
         init_model: Optional["Booster"] = None,
         mesh=None,
         callbacks: Optional[List[Callable]] = None,
+        delegate=None,
     ) -> "Booster":
         cfg = self.config
         x = np.asarray(x, np.float64)
@@ -280,7 +281,6 @@ class Booster:
         is_rf = cfg.boosting_type == "rf"
         is_dart = cfg.boosting_type == "dart"
         is_goss = cfg.boosting_type == "goss"
-        shrinkage = 1.0 if is_rf else cfg.learning_rate
         rf_sum = np.zeros((n, c))
         if is_rf and init_model is not None and init_model.trees:
             # seed the running sum with inherited trees so 1/T renormalization
@@ -311,7 +311,17 @@ class Booster:
         rounds_no_improve = 0
         bag_mask = np.ones(n)
 
+        if delegate is not None:
+            delegate.before_training(self)
         for it in range(cfg.num_iterations):
+            # per-iteration rate: delegate override OR the config value —
+            # cfg itself is never mutated (the override must not be sticky)
+            cur_lr = cfg.learning_rate
+            if delegate is not None:
+                delegate.before_iteration(self, it)
+                lr = delegate.get_learning_rate(self, it)
+                if lr is not None:
+                    cur_lr = float(lr)
             # --- dart: drop trees before computing gradients
             dropped: List[int] = []
             if is_dart and self.trees and rng.random() >= cfg.skip_drop:
@@ -361,7 +371,7 @@ class Booster:
                 # normalize: new tree weighted 1/(k+1); dropped trees scaled k/(k+1)
                 k = len(dropped)
                 norm = k / (k + 1.0)
-                new_w = cfg.learning_rate / (k + 1.0)
+                new_w = cur_lr / (k + 1.0)
                 for t_idx in dropped:
                     self.tree_weights[t_idx] *= norm
                     scores[:, t_idx % c] += self.tree_weights[t_idx] * \
@@ -370,7 +380,7 @@ class Booster:
             elif is_rf:
                 weight = 1.0
             else:
-                weight = shrinkage
+                weight = cur_lr
 
             new_outputs = []
             for cls, tree in enumerate(trees_this_iter):
@@ -421,7 +431,15 @@ class Booster:
 
             for cb in callbacks or []:
                 cb(self, it)
+            if delegate is not None:
+                delegate.after_iteration(
+                    self, it, [r for r in self.eval_history if r.iteration == it]
+                )
+                if delegate.should_stop(self, it):
+                    break
 
+        if delegate is not None:
+            delegate.after_training(self)
         self._forest_cache = None
         return self
 

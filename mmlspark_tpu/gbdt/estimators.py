@@ -74,6 +74,10 @@ class _GBDTParams:
     top_rate = Param("goss top rate", default=0.2, converter=TypeConverters.to_float)
     other_rate = Param("goss other rate", default=0.1, converter=TypeConverters.to_float)
     seed = Param("random seed", default=0, converter=TypeConverters.to_int)
+    delegate = ComplexParam("GBDTDelegate with before/after-iteration hooks "
+                            "and dynamic learning rate "
+                            "(LightGBMDelegate.scala); runtime-only, not "
+                            "persisted", default=None, transient=True)
 
     def _base_config(self, **overrides) -> TrainConfig:
         cfg = TrainConfig(
@@ -139,6 +143,7 @@ class _GBDTParams:
         if mesh is None:
             mesh = self._resolve_mesh()
         nb = self.num_batches
+        delegate = self.get_or_default("delegate")
         if nb and nb > 1:
             rng = np.random.default_rng(self.seed)
             perm = rng.permutation(len(x))
@@ -149,11 +154,13 @@ class _GBDTParams:
                 b.fit(x[idx], y[idx],
                       sample_weight=None if w is None else w[idx],
                       group=None if group is None else group[idx],
-                      eval_set=eval_set, init_model=booster, mesh=mesh)
+                      eval_set=eval_set, init_model=booster, mesh=mesh,
+                      delegate=delegate)
                 booster = b
             return booster
         booster = Booster(cfg)
-        booster.fit(x, y, sample_weight=w, group=group, eval_set=eval_set, mesh=mesh)
+        booster.fit(x, y, sample_weight=w, group=group, eval_set=eval_set,
+                    mesh=mesh, delegate=delegate)
         return booster
 
 

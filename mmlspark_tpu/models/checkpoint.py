@@ -1,0 +1,116 @@
+"""Training checkpoint / resume via orbax.
+
+Reference: checkpoint/resume in the reference is ComplexParams save/load for
+models plus engine warm-start (SURVEY §5: LightGBM modelString, VW
+initialModel bytes, streaming checkpointLocation).  The TPU build's training
+loops additionally need step-level checkpointing of (params, batch_stats,
+opt_state, step): orbax handles atomic async writes, retention, and
+restore-into-sharded-arrays.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .training import TrainState
+
+__all__ = ["CheckpointManager", "save_checkpoint", "restore_checkpoint",
+           "latest_step"]
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with TrainState pack/unpack + retention."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, state: TrainState, step: Optional[int] = None,
+             wait: bool = True) -> int:
+        import orbax.checkpoint as ocp
+
+        step = int(state.step if step is None else step)
+        payload = {
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+            "step": np.asarray(step),
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        if wait:
+            self._mgr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[TrainState] = None) -> TrainState:
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        if template is not None:
+            target = {
+                "params": template.params,
+                "batch_stats": template.batch_stats,
+                "opt_state": template.opt_state,
+                "step": np.asarray(0),
+            }
+            payload = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
+        else:
+            payload = self._mgr.restore(step)
+        # host numpy leaves: uncommitted, so the caller can re-shard the
+        # resumed state onto ANY mesh (restoring committed single-device
+        # arrays would conflict with jitted steps' input shardings)
+        payload = jax.tree.map(lambda x: np.asarray(x), payload)
+        return TrainState(
+            params=payload["params"],
+            batch_stats=payload["batch_stats"],
+            opt_state=payload["opt_state"],
+            step=int(np.asarray(payload["step"])),
+        )
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_checkpoint(directory: str, state: TrainState,
+                    step: Optional[int] = None) -> int:
+    mgr = CheckpointManager(directory)
+    try:
+        return mgr.save(state, step)
+    finally:
+        mgr.close()
+
+
+def restore_checkpoint(directory: str,
+                       template: Optional[TrainState] = None,
+                       step: Optional[int] = None) -> TrainState:
+    mgr = CheckpointManager(directory)
+    try:
+        return mgr.restore(step, template)
+    finally:
+        mgr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    mgr = CheckpointManager(directory)
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()
