@@ -19,7 +19,36 @@ __all__ = [
     "FixedBufferedBatcher",
     "DynamicBufferedBatcher",
     "time_interval_batcher",
+    "buffered_prefetch",
 ]
+
+
+def buffered_prefetch(it: Iterable[T], buffer_size: int = 2) -> Iterator[T]:
+    """Run `it` on a background thread, keeping up to `buffer_size` items
+    ready — the double-buffered host->device feed (Batchers.scala:65): host
+    batch assembly overlaps device compute of the previous batch.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+    sentinel = object()
+    err: List[BaseException] = []
+
+    def run():
+        try:
+            for x in it:
+                q.put(x)
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            err.append(e)
+        finally:
+            q.put(sentinel)
+
+    threading.Thread(target=run, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is sentinel:
+            if err:
+                raise err[0]
+            return
+        yield item
 
 
 def fixed_batcher(it: Iterable[T], batch_size: int) -> Iterator[List[T]]:

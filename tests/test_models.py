@@ -127,3 +127,62 @@ class TestImageFeaturizer:
                 b"garbage-not-an-image"]
         out = ImageFeaturizer(bundle=tiny_resnet).transform(Table({"image": rows}))
         assert out.num_rows == 1
+
+
+class TestAsyncFeed:
+    """The device-side preprocess + async double-buffered feed paths."""
+
+    def test_ragged_shape_groups_preserve_order(self, tiny_resnet, rng):
+        # mixed sizes + grayscale: one XLA program per shape group, rows
+        # scattered back in original order
+        shapes = [(40, 30, 3), (32, 32, 3), (40, 30, 3), (64, 48, 1), (32, 32, 3)]
+        rows = [array_to_image_row(rng.integers(0, 255, s).astype(np.uint8))
+                for s in shapes]
+        t = Table({"image": rows, "id": np.arange(len(rows))})
+        f = ImageFeaturizer(bundle=tiny_resnet, batch_size=2)
+        out = f.transform(t)
+        assert out["features"].shape == (5, 512)
+        # same image content -> same features regardless of group ordering
+        single = ImageFeaturizer(bundle=tiny_resnet).transform(
+            Table({"image": [rows[3]]}))
+        np.testing.assert_allclose(
+            out["features"][3], single["features"][0], rtol=2e-4, atol=2e-4)
+
+    def test_uint8_feed_matches_float(self, tiny_resnet, rng):
+        from mmlspark_tpu.models.tpu_model import ImagePreprocess
+
+        arrs = [rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+                for _ in range(7)]
+        t = Table({"x": arrs})
+        pre = ImagePreprocess(32, 32, mean=[1.0, 2.0, 3.0], std=[4.0, 5.0, 6.0])
+        m8 = TPUModel(bundle=tiny_resnet, input_col="x", output_col="y",
+                      fetch_node="pool", batch_size=3, preprocess=pre,
+                      group_by_shape=True, feed_dtype="uint8")
+        mf = TPUModel(bundle=tiny_resnet, input_col="x", output_col="y",
+                      fetch_node="pool", batch_size=3, preprocess=pre,
+                      group_by_shape=True, feed_dtype="float32")
+        np.testing.assert_allclose(
+            m8.transform(t)["y"], mf.transform(t)["y"], rtol=1e-5, atol=1e-5)
+
+    def test_preprocess_is_picklable(self):
+        import pickle
+
+        from mmlspark_tpu.models.tpu_model import ImagePreprocess
+
+        pre = ImagePreprocess(8, 8, mean=[0.5], std=[0.25])
+        back = pickle.loads(pickle.dumps(pre))
+        assert back.key == pre.key
+
+    def test_buffered_prefetch_order_and_errors(self):
+        from mmlspark_tpu.core.batching import buffered_prefetch
+
+        assert list(buffered_prefetch(iter(range(100)), 4)) == list(range(100))
+
+        def boom():
+            yield 1
+            raise ValueError("producer failed")
+
+        it = buffered_prefetch(boom(), 2)
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="producer failed"):
+            list(it)
