@@ -13,10 +13,14 @@ Scala over the SWIG'd C++ engine) redesigned TPU-first:
     driver-socket rendezvous + native TCP ring AllReduce
     (LightGBMBase.scala:392-430, TrainUtils.scala:279-295, LGBM_NetworkInit);
   - voting-parallel mode reduces collective volume by pre-selecting top-k
-    features per shard (params/LightGBMParams.scala:16-21).
+    features per shard (params/LightGBMParams.scala:16-21);
+  - high-dimensional hashed features train through a sparse CSR dataset
+    path (`CSRMatrix` + ELL histograms with implicit-zero fix-up) — the
+    dense/sparse duality of dataset/DatasetAggregator.scala:69-515.
 """
 from .binning import BinMapper
 from .boosting import Booster, TrainConfig
+from .sparse import CSRMatrix, SparseBinMapper
 from .estimators import (
     GBDTClassificationModel,
     GBDTClassifier,
@@ -33,6 +37,8 @@ from .tree import Tree
 __all__ = [
     "BinMapper",
     "Booster",
+    "CSRMatrix",
+    "SparseBinMapper",
     "TrainConfig",
     "Tree",
     "GBDTClassifier",

@@ -22,7 +22,23 @@ import numpy as np
 from .binning import BinMapper
 from .histogram import HistogramBuilder
 from .objectives import Objective, get_objective, lambdarank_grad
+from .sparse import (
+    CSRMatrix,
+    SparseBinMapper,
+    SparseBinnedView,
+    SparseHistogramBuilder,
+    effective_sparse_max_bin,
+)
 from .tree import GrowerConfig, Tree, TreeGrower, predict_forest, tree_arrays_for_jit
+
+
+def _tree_out(tree: Tree, ex) -> np.ndarray:
+    """Per-tree output for either representation: raw float rows (dense) or
+    a pre-binned SparseBinnedView (bin codes are monotone in value, so the
+    bin-threshold traversal is exact)."""
+    if isinstance(ex, SparseBinnedView):
+        return tree.predict_binned(ex)
+    return tree.predict_raw(ex)
 
 __all__ = ["TrainConfig", "Booster", "EvalRecord"]
 
@@ -128,13 +144,20 @@ class Booster:
         return len(self.trees) // self.num_class
 
     def _prepare_x(self, x: np.ndarray) -> np.ndarray:
-        """Categorical columns are split on bin codes; encode them once."""
+        """Categorical columns are split on bin codes; encode them once.
+        CSR input is pre-binned through the sparse mapper instead (trees
+        then traverse on bin codes, see _tree_out)."""
+        if isinstance(x, CSRMatrix):
+            if not isinstance(self.bin_mapper, SparseBinMapper):
+                raise ValueError("booster was trained dense; densify the "
+                                 "CSR input or retrain on CSRMatrix")
+            return self.bin_mapper.transform(x)
         x = np.asarray(x, np.float64)
         if self.bin_mapper is not None:
             x = self.bin_mapper.encode_categoricals(x)
         return x
 
-    def _raw_scores(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
+    def _raw_scores(self, x, num_iteration: Optional[int] = None) -> np.ndarray:
         """[N] or [N, C] raw margin."""
         c = self.num_class
         n = len(x)
@@ -142,11 +165,13 @@ class Booster:
         out = np.tile(self.init_score.reshape(1, -1), (n, 1)).astype(np.float64)
         limit = len(self.trees) if num_iteration is None else num_iteration * c
         for i, tree in enumerate(self.trees[:limit]):
-            out[:, i % c] += self.tree_weights[i] * tree.predict_raw(x)
+            out[:, i % c] += self.tree_weights[i] * _tree_out(tree, x)
         return out[:, 0] if c == 1 else out
 
     def raw_scores_jit(self, x) -> np.ndarray:
         """Jitted forest prediction (single-output objectives)."""
+        if isinstance(x, CSRMatrix):
+            return self._raw_scores(x)
         if self.num_class != 1 or not self.trees:
             return self._raw_scores(np.asarray(x))
         if self._forest_cache is None:
@@ -160,14 +185,18 @@ class Booster:
                              jnp.asarray(w), md)
         return np.asarray(res, np.float64) + float(self.init_score[0])
 
-    def score(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
+    def score(self, x, num_iteration: Optional[int] = None) -> np.ndarray:
         """User-facing prediction (probabilities for binary/multiclass)."""
-        return self.objective.transform(self._raw_scores(np.asarray(x, np.float64),
-                                                         num_iteration))
+        if not isinstance(x, CSRMatrix):
+            x = np.asarray(x, np.float64)
+        return self.objective.transform(self._raw_scores(x, num_iteration))
 
-    def predict_leaf(self, x: np.ndarray) -> np.ndarray:
+    def predict_leaf(self, x) -> np.ndarray:
         """[N, T] terminal-leaf indices (predictLeaf parity)."""
         x = self._prepare_x(x)
+        if isinstance(x, SparseBinnedView):
+            return np.stack([t.predict_leaf_index_binned(x) for t in self.trees],
+                            axis=1)
         return np.stack([t.predict_leaf_index(x) for t in self.trees], axis=1)
 
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
@@ -183,12 +212,13 @@ class Booster:
                 np.add.at(out, t.split_feature[internal], 1.0)
         return out
 
-    def features_shap(self, x: np.ndarray) -> np.ndarray:
+    def features_shap(self, x) -> np.ndarray:
         """Per-feature contributions [N, F+1] (last = expected value), via
         SAABAS-style path attribution per tree (fast approximation of the
         reference's featuresShap; exact interventional SHAP lives in
         mmlspark_tpu.explainers)."""
         x = self._prepare_x(x)
+        binned_input = isinstance(x, SparseBinnedView)
         n = len(x)
         f = self.bin_mapper.num_features_ if self.bin_mapper else x.shape[1]
         out = np.zeros((n, f + 1))
@@ -215,7 +245,11 @@ class Booster:
                 if not internal.any():
                     break
                 fx = x[np.arange(n), np.maximum(sf, 0)]
-                go_left = np.where(np.isnan(fx), True, fx <= tree.threshold_value[node])
+                if binned_input:
+                    go_left = fx.astype(np.int32) <= tree.threshold_bin[node]
+                else:
+                    go_left = np.where(np.isnan(fx), True,
+                                       fx <= tree.threshold_value[node])
                 nxt = np.where(go_left, tree.left[node], tree.right[node])
                 delta = exp_val[nxt] - exp_val[node]
                 rows = np.where(internal)[0]
@@ -238,7 +272,9 @@ class Booster:
         delegate=None,
     ) -> "Booster":
         cfg = self.config
-        x = np.asarray(x, np.float64)
+        sparse = isinstance(x, CSRMatrix)
+        if not sparse:
+            x = np.asarray(x, np.float64)
         y = np.asarray(y, np.float64)
         n = len(x)
         w = np.ones(n) if sample_weight is None else np.asarray(sample_weight, np.float64)
@@ -250,16 +286,25 @@ class Booster:
             # inherited trees' threshold_bin stay valid on this data
             self.bin_mapper = init_model.bin_mapper
         if self.bin_mapper is None:
-            self.bin_mapper = BinMapper(cfg.max_bin,
-                                        categorical_features=cfg.categorical_features,
-                                        seed=cfg.seed)
+            if sparse:
+                # CSR ingestion (DatasetAggregator.scala sparse variant):
+                # bins capped so the [F, B, 3] histogram fits device memory
+                self.bin_mapper = SparseBinMapper(
+                    effective_sparse_max_bin(cfg.max_bin, x.shape[1],
+                                             cfg.num_leaves),
+                    seed=cfg.seed)
+            else:
+                self.bin_mapper = BinMapper(cfg.max_bin,
+                                            categorical_features=cfg.categorical_features,
+                                            seed=cfg.seed)
             self.bin_mapper.fit(x)
         binned = self.bin_mapper.transform(x)
 
         use_mesh = mesh if cfg.parallelism in ("data_parallel", "voting_parallel") else None
-        builder = HistogramBuilder(binned, self.bin_mapper.num_bins, mesh=use_mesh,
-                                   voting=cfg.parallelism == "voting_parallel",
-                                   top_k=cfg.top_k)
+        builder_cls = SparseHistogramBuilder if sparse else HistogramBuilder
+        builder = builder_cls(binned, self.bin_mapper.num_bins, mesh=use_mesh,
+                              voting=cfg.parallelism == "voting_parallel",
+                              top_k=cfg.top_k)
         grower = TreeGrower(builder, cfg.grower_config(),
                             self.bin_mapper.bin_upper_value, rng)
 
@@ -296,8 +341,9 @@ class Booster:
             sets = list(eval_set) if eval_set else [("train", x, y) +
                                                     ((group,) if is_rank else ())]
             for entry in sets:
-                name, ex_raw, ey = entry[0], np.asarray(entry[1], np.float64), \
-                    np.asarray(entry[2], np.float64)
+                ex_raw = entry[1] if isinstance(entry[1], CSRMatrix) \
+                    else np.asarray(entry[1], np.float64)
+                name, ey = entry[0], np.asarray(entry[2], np.float64)
                 eg = np.asarray(entry[3]) if len(entry) > 3 else None
                 if init_model is not None and init_model.trees:
                     # _raw_scores encodes categoricals itself: feed raw rows
@@ -408,14 +454,14 @@ class Booster:
                 for name, ex, ey, eg, eraw in eval_state:
                     if incremental:
                         for cls, tree in enumerate(trees_this_iter):
-                            eraw[:, cls] += weight * tree.predict_raw(ex)
+                            eraw[:, cls] += weight * _tree_out(tree, ex)
                         raw_e = eraw
                     else:
                         # dart/rf rescale earlier trees: re-predict (ex is
                         # already categorical-encoded, so loop trees directly)
                         raw_e = np.tile(self.init_score.reshape(1, -1), (len(ex), 1))
                         for i, tree in enumerate(self.trees):
-                            raw_e[:, i % c] += self.tree_weights[i] * tree.predict_raw(ex)
+                            raw_e[:, i % c] += self.tree_weights[i] * _tree_out(tree, ex)
                     m, v = self._eval_metric_from_raw(raw_e, ey, eg)
                     self.eval_history.append(EvalRecord(it, name, m, v))
                     metric_val = v  # last eval set drives early stopping
@@ -491,7 +537,12 @@ class Booster:
     def from_model_string(s: str) -> "Booster":
         d = json.loads(s)
         cfg = TrainConfig(**d["config"])
-        b = Booster(cfg, BinMapper.from_dict(d["bin_mapper"]) if d["bin_mapper"] else None)
+        md = d["bin_mapper"]
+        mapper = None
+        if md:
+            mapper = (SparseBinMapper.from_dict(md) if md.get("kind") == "sparse"
+                      else BinMapper.from_dict(md))
+        b = Booster(cfg, mapper)
         b.init_score = np.asarray(d["init_score"], np.float64)
         b.tree_weights = list(d["tree_weights"])
         b.trees = [Tree.from_dict(t) for t in d["trees"]]

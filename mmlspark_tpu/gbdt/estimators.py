@@ -19,6 +19,7 @@ from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
 from ..core.schema import Table, features_matrix
 from .boosting import Booster, TrainConfig
+from .sparse import CSRMatrix, SparseBinMapper
 
 __all__ = [
     "GBDTClassifier", "GBDTClassificationModel",
@@ -28,7 +29,25 @@ __all__ = [
 ]
 
 
-def _features_matrix(col: np.ndarray) -> np.ndarray:
+def _is_sparse_column(col: np.ndarray) -> bool:
+    """Object column of (indices, values) pairs — the hashed namespace
+    format produced by online.featurizer.VowpalWabbitFeaturizer."""
+    return (col.dtype == object and len(col) > 0
+            and isinstance(col[0], tuple) and len(col[0]) == 2
+            and isinstance(col[0][0], np.ndarray))
+
+
+def _features_matrix(col: np.ndarray, meta: Optional[dict] = None,
+                     booster: Optional[Booster] = None):
+    """Dense [N, F] matrix, or a CSRMatrix for hashed sparse columns (the
+    CSR dataset path, reference dataset/DatasetAggregator.scala:69-515)."""
+    if _is_sparse_column(col):
+        nf = None
+        if meta and "num_bits" in meta:
+            nf = 1 << int(meta["num_bits"])
+        elif booster is not None and isinstance(booster.bin_mapper, SparseBinMapper):
+            nf = booster.bin_mapper.num_features_
+        return CSRMatrix.from_pairs_column(col, num_features=nf)
     return features_matrix(col, dtype=np.float64)
 
 
@@ -109,7 +128,8 @@ class _GBDTParams:
         return cfg
 
     def _split_data(self, table: Table):
-        x = _features_matrix(table[self.features_col])
+        x = _features_matrix(table[self.features_col],
+                             meta=table.get_meta(self.features_col))
         y = np.asarray(table[self.label_col], np.float64)
         w = (np.asarray(table[self.weight_col], np.float64)
              if self.weight_col and self.weight_col in table else None)
@@ -186,10 +206,12 @@ class _GBDTModelBase(Model):
         return list(self.booster.feature_importances(importance_type))
 
     def predict_leaf(self, table: Table) -> np.ndarray:
-        return self.booster.predict_leaf(_features_matrix(table[self.features_col]))
+        return self.booster.predict_leaf(
+            _features_matrix(table[self.features_col], booster=self.booster))
 
     def features_shap(self, table: Table) -> np.ndarray:
-        return self.booster.features_shap(_features_matrix(table[self.features_col]))
+        return self.booster.features_shap(
+            _features_matrix(table[self.features_col], booster=self.booster))
 
 
 @register_stage
@@ -237,7 +259,7 @@ class GBDTClassificationModel(_GBDTModelBase):
     raw_prediction_col = Param("raw score column", default="rawPrediction")
 
     def _transform(self, table: Table) -> Table:
-        x = _features_matrix(table[self.features_col])
+        x = _features_matrix(table[self.features_col], booster=self.booster)
         b = self.booster
         raw = b._raw_scores(x)
         probs = b.objective.transform(raw)
@@ -277,7 +299,7 @@ class GBDTRegressor(Estimator, _GBDTParams):
 @register_stage
 class GBDTRegressionModel(_GBDTModelBase):
     def _transform(self, table: Table) -> Table:
-        x = _features_matrix(table[self.features_col])
+        x = _features_matrix(table[self.features_col], booster=self.booster)
         return table.with_column(self.prediction_col, self.booster.score(x))
 
 
@@ -290,7 +312,8 @@ class GBDTRanker(Estimator, _GBDTParams):
     max_position = Param("NDCG truncation", default=30, converter=TypeConverters.to_int)
 
     def _fit(self, table: Table) -> "GBDTRankerModel":
-        x = _features_matrix(table[self.features_col])
+        x = _features_matrix(table[self.features_col],
+                             meta=table.get_meta(self.features_col))
         y = np.asarray(table[self.label_col], np.float64)
         w = (np.asarray(table[self.weight_col], np.float64)
              if self.weight_col and self.weight_col in table else None)
@@ -309,7 +332,7 @@ class GBDTRanker(Estimator, _GBDTParams):
 @register_stage
 class GBDTRankerModel(_GBDTModelBase):
     def _transform(self, table: Table) -> Table:
-        x = _features_matrix(table[self.features_col])
+        x = _features_matrix(table[self.features_col], booster=self.booster)
         return table.with_column(self.prediction_col, self.booster._raw_scores(x))
 
 

@@ -140,7 +140,44 @@ def best_split(
     )
 
 
-class HistogramBuilder:
+class RowShardedBuilderBase:
+    """Shared row-axis plumbing for the dense and sparse histogram builders:
+    row padding to a shard multiple and mesh-aware placement of the per-row
+    gradient/hessian/weight/mask arrays."""
+
+    mesh = None
+    axis = "data"
+    _pad = 0
+
+    def _pad_rows(self, arr, fill=0.0):
+        if self._pad:
+            pad_shape = (self._pad,) + arr.shape[1:]
+            arr = np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
+        return arr
+
+    def device_arrays(self, grad, hess, weight):
+        """Place per-row arrays with the same row sharding as the data."""
+        grad = self._pad_rows(np.asarray(grad, np.float32))
+        hess = self._pad_rows(np.asarray(hess, np.float32))
+        weight = self._pad_rows(np.asarray(weight, np.float32))
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(self.mesh, P(self.axis))
+            return (jax.device_put(grad, sh), jax.device_put(hess, sh),
+                    jax.device_put(weight, sh))
+        return jax.device_put(grad), jax.device_put(hess), jax.device_put(weight)
+
+    def node_mask(self, mask: np.ndarray):
+        mask = self._pad_rows(np.asarray(mask, bool), fill=False)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(mask, NamedSharding(self.mesh, P(self.axis)))
+        return jax.device_put(mask)
+
+
+class HistogramBuilder(RowShardedBuilderBase):
     """Owns device-resident binned data and builds per-node histograms.
 
     Single-chip path: one jitted segment_sum.  Distributed path
@@ -186,12 +223,6 @@ class HistogramBuilder:
             self._sharded_fn = None
             self._sharded_local_fn = None
 
-    def _pad_rows(self, arr, fill=0.0):
-        if self._pad:
-            pad_shape = (self._pad,) + arr.shape[1:]
-            arr = np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
-        return arr
-
     def _make_sharded(self, mesh, axis):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from jax import shard_map
@@ -209,27 +240,6 @@ class HistogramBuilder:
             out_specs=P(),
         )
         return jax.jit(fn)
-
-    def device_arrays(self, grad, hess, weight):
-        """Place per-row arrays with the same sharding as the binned data."""
-        grad = self._pad_rows(np.asarray(grad, np.float32))
-        hess = self._pad_rows(np.asarray(hess, np.float32))
-        weight = self._pad_rows(np.asarray(weight, np.float32))
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            sh = NamedSharding(self.mesh, P(self.axis))
-            return (jax.device_put(grad, sh), jax.device_put(hess, sh),
-                    jax.device_put(weight, sh))
-        return jax.device_put(grad), jax.device_put(hess), jax.device_put(weight)
-
-    def node_mask(self, mask: np.ndarray):
-        mask = self._pad_rows(np.asarray(mask, bool), fill=False)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            return jax.device_put(mask, NamedSharding(self.mesh, P(self.axis)))
-        return jax.device_put(mask)
 
     def build(self, grad, hess, weight, mask):
         """grad/hess/weight/mask: device arrays from device_arrays/node_mask."""

@@ -60,20 +60,10 @@ class Tree:
         return int(depth.max()) if self.num_nodes else 0
 
     # ---- prediction ----------------------------------------------------
-    def predict_binned(self, binned: np.ndarray) -> np.ndarray:
-        """Vectorized traversal on binned codes (training-time path)."""
-        n = len(binned)
-        node = np.zeros(n, np.int32)
-        for _ in range(max(self.max_depth, 1)):
-            f = self.split_feature[node]
-            internal = f >= 0
-            if not internal.any():
-                break
-            fx = binned[np.arange(n), np.maximum(f, 0)].astype(np.int32)
-            go_left = fx <= self.threshold_bin[node]
-            nxt = np.where(go_left, self.left[node], self.right[node])
-            node = np.where(internal, nxt, node)
-        return self.value[node]
+    def predict_binned(self, binned) -> np.ndarray:
+        """Vectorized traversal on binned codes (training-time path; dense
+        codes or a sparse.SparseBinnedView)."""
+        return self.value[self.predict_leaf_index_binned(binned)]
 
     def predict_raw(self, x: np.ndarray) -> np.ndarray:
         """Vectorized traversal on raw float features (inference path);
@@ -105,6 +95,22 @@ class Tree:
                 break
             fx = x[np.arange(n), np.maximum(f, 0)]
             go_left = np.where(np.isnan(fx), True, fx <= self.threshold_value[node])
+            nxt = np.where(go_left, self.left[node], self.right[node])
+            node = np.where(internal, nxt, node)
+        return node
+
+    def predict_leaf_index_binned(self, binned) -> np.ndarray:
+        """predict_leaf_index on bin codes (dense codes or a
+        sparse.SparseBinnedView) — routes with threshold_bin."""
+        n = len(binned)
+        node = np.zeros(n, np.int32)
+        for _ in range(max(self.max_depth, 1)):
+            f = self.split_feature[node]
+            internal = f >= 0
+            if not internal.any():
+                break
+            fx = binned[np.arange(n), np.maximum(f, 0)].astype(np.int32)
+            go_left = fx <= self.threshold_bin[node]
             nxt = np.where(go_left, self.left[node], self.right[node])
             node = np.where(internal, nxt, node)
         return node
