@@ -221,6 +221,12 @@ class Booster:
         binned_input = isinstance(x, SparseBinnedView)
         n = len(x)
         f = self.bin_mapper.num_features_ if self.bin_mapper else x.shape[1]
+        if n * (f + 1) > 200_000_000:
+            raise ValueError(
+                f"features_shap would materialize a dense [{n}, {f + 1}] "
+                "contribution matrix; for high-dimensional hashed features "
+                "attribute through mmlspark_tpu.explainers (KernelSHAP) or "
+                "call on smaller row batches")
         out = np.zeros((n, f + 1))
         out[:, -1] = self.init_score.mean()
         for w, tree in zip(self.tree_weights, self.trees):
@@ -350,7 +356,9 @@ class Booster:
                     eraw = init_model._raw_scores(ex_raw).reshape(len(ex_raw), -1).copy()
                 else:
                     eraw = np.tile(self.init_score.reshape(1, -1), (len(ex_raw), 1))
-                ex = self._prepare_x(ex_raw)
+                # the default eval set IS the training data: reuse its binned
+                # view instead of re-sorting the whole CSR
+                ex = binned if ex_raw is x and sparse else self._prepare_x(ex_raw)
                 eval_state.append((name, ex, ey, eg, eraw))
 
         best_metric = np.inf

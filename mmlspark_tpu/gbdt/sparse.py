@@ -93,6 +93,13 @@ class CSRMatrix:
             data = np.empty(0, np.float64)
         if num_features is None:
             num_features = int(indices.max()) + 1 if nnz else 1
+        elif nnz and indices.max() >= num_features:
+            # must precede the dedup keying below, or out-of-range indices
+            # would wrap into wrong (row, feature) cells instead of erroring
+            raise ValueError(
+                f"feature index {int(indices.max())} out of range for "
+                f"{num_features} features — was the scoring data hashed "
+                "with more bits than the training data?")
         # sum duplicate (row, index) pairs
         rows = np.repeat(np.arange(n, dtype=np.int64), lens)
         keys = rows * np.int64(num_features) + indices
@@ -175,14 +182,17 @@ class SparseBinMapper:
     def fit(self, x: CSRMatrix) -> "SparseBinMapper":
         n, f = x.shape
         self.num_features_ = f
+        # checked on the FULL data (the subsample could miss a NaN) and
+        # again in transform: NaN stored values would otherwise silently
+        # bin to the top bin, inverting the dense path's NaN-goes-left rule
+        if np.isnan(x.data).any():
+            raise ValueError("NaN stored values are not supported on the "
+                             "sparse path (absent entries are zeros)")
         indices, data = x.indices, x.data
         if n > self.sample_count:
             rng = np.random.default_rng(self.seed)
             sub = x.take_rows(np.sort(rng.choice(n, self.sample_count, replace=False)))
             indices, data = sub.indices, sub.data
-        if np.isnan(data).any():
-            raise ValueError("NaN stored values are not supported on the "
-                             "sparse path (absent entries are zeros)")
         # group nonzeros by feature (CSC ordering) and bin each group
         order = np.argsort(indices, kind="stable")
         sorted_feats = indices[order]
@@ -224,6 +234,9 @@ class SparseBinMapper:
         if x.shape[1] != self.num_features_:
             raise ValueError(
                 f"expected {self.num_features_} features, got {x.shape[1]}")
+        if np.isnan(x.data).any():
+            raise ValueError("NaN stored values are not supported on the "
+                             "sparse path (absent entries are zeros)")
         nnz = x.nnz
         order = np.argsort(x.indices, kind="stable")
         sorted_feats = x.indices[order]

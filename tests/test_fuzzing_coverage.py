@@ -1,0 +1,1042 @@
+"""Registry-wide fuzzing sweep: every registered stage must be fuzzed.
+
+Reference: core/src/test/.../FuzzingTest.scala — a reflection sweep asserting
+every `Wrappable` class in the jar is covered by a TransformerFuzzing /
+EstimatorFuzzing suite.  Here the registry (core/registry.all_stages) is the
+reflection source; every registered class must appear in exactly one bucket:
+
+  - FULL      an example (stage, table) factory; runs the complete harness
+              (save/load round-trip + transform equality, tests/fuzzing.py).
+  - SERDE     save/load + param-equality only, with a recorded reason —
+              network transformers whose transform needs a live endpoint
+              (their transform behavior is mock-server-tested elsewhere).
+  - VIA_ESTIMATOR  Model classes produced by a FULL estimator example; the
+              estimator harness round-trips the fitted model, and this sweep
+              asserts the estimator example really produces that model type.
+
+An unregistered bucket entry or an uncovered registry class fails the sweep.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import registry
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.io.image import array_to_image_row
+
+from fuzzing import check_params_equal, fuzz, roundtrip
+
+# ----------------------------------------------------------------------
+# example tables (built lazily; kept tiny — this sweep runs ~90 stages)
+# ----------------------------------------------------------------------
+
+_RNG = np.random.default_rng(42)
+
+
+def _num_table(n=24):
+    return Table({
+        "value": _RNG.normal(size=n),
+        "k": np.asarray(list("ab") * (n // 2)),
+        "label": (_RNG.random(n) > 0.5).astype(np.float64),
+    })
+
+
+def _cls_table(n=60, d=4):
+    x = _RNG.normal(size=(n, d))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return Table({"features": x.astype(np.float32), "label": y})
+
+
+def _reg_table(n=60, d=4):
+    x = _RNG.normal(size=(n, d))
+    y = 2 * x[:, 0] - x[:, 1] + 0.05 * _RNG.normal(size=n)
+    return Table({"features": x.astype(np.float32), "label": y})
+
+
+def _img_table(n=6, h=16, w=12):
+    rows = np.empty(n, object)
+    for i in range(n):
+        arr = _RNG.integers(0, 255, size=(h, w, 3), dtype=np.uint8)
+        rows[i] = array_to_image_row(arr)
+    return Table({"image": rows})
+
+
+def _text_table():
+    return Table({"text": np.asarray(
+        ["the quick brown fox jumps over the lazy dog",
+         "pack my box with five dozen liquor jugs",
+         "how vexingly quick daft zebras jump",
+         "the five boxing wizards jump quickly"] * 4, object)})
+
+
+def _ratings_table():
+    rng = np.random.default_rng(3)
+    n_users, n_items, n = 12, 10, 120
+    return Table({
+        "user": rng.integers(0, n_users, n).astype(np.int64),
+        "item": rng.integers(0, n_items, n).astype(np.int64),
+        "rating": rng.integers(1, 6, n).astype(np.float64),
+    })
+
+
+def _hashed_table():
+    from mmlspark_tpu.online.featurizer import VowpalWabbitFeaturizer
+
+    t = _cls_table(40)
+    cols = Table({
+        "a": np.asarray(t["features"])[:, 0],
+        "b": np.asarray(t["features"])[:, 1],
+        "label": t["label"],
+    })
+    return VowpalWabbitFeaturizer(
+        input_cols=["a", "b"], output_col="features", num_bits=12
+    ).transform(cols)
+
+
+def _tiny_bundle():
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.bundle import FlaxBundle
+
+    return FlaxBundle("resnet18", {"num_classes": 10, "dtype": jnp.float32},
+                      input_shape=(32, 32, 3), seed=0)
+
+
+# module-level udfs: picklable, so complex params round-trip
+def _square(v):
+    return v * v
+
+
+def _plus_one(v):
+    return v + 1
+
+
+def _row_to_request(row):
+    from mmlspark_tpu.io.http.schema import to_http_request
+
+    payload = {k: (v.item() if hasattr(v, "item") else v)
+               for k, v in dict(row).items()}
+    return to_http_request("http://localhost:9/x", payload)
+
+
+def _response_status(resp):
+    return None if resp is None else resp.status_code
+
+
+def _fake_responses_table():
+    from mmlspark_tpu.io.http.schema import HTTPResponseData
+
+    resps = np.empty(3, object)
+    for i in range(3):
+        resps[i] = HTTPResponseData(
+            status_code=200, reason="OK",
+            headers={"Content-Type": "application/json"},
+            entity=b'{"v": %d}' % i)
+    return Table({"response": resps})
+
+
+# ----------------------------------------------------------------------
+# the buckets
+# ----------------------------------------------------------------------
+
+FULL = {}
+
+
+def full(name):
+    def wrap(fn):
+        FULL[name] = fn
+        return fn
+    return wrap
+
+
+# --- core plumbing stages ----------------------------------------------
+
+@full("Cacher")
+def _ex_cacher():
+    from mmlspark_tpu.stages.basic import Cacher
+    return Cacher(), _num_table()
+
+
+@full("DropColumns")
+def _ex_drop():
+    from mmlspark_tpu.stages.basic import DropColumns
+    return DropColumns(cols=["k"]), _num_table()
+
+
+@full("SelectColumns")
+def _ex_select():
+    from mmlspark_tpu.stages.basic import SelectColumns
+    return SelectColumns(cols=["value"]), _num_table()
+
+
+@full("RenameColumn")
+def _ex_rename():
+    from mmlspark_tpu.stages.basic import RenameColumn
+    return RenameColumn(input_col="value", output_col="v2"), _num_table()
+
+
+@full("Repartition")
+def _ex_repartition():
+    from mmlspark_tpu.stages.basic import Repartition
+    return Repartition(n=3), _num_table()
+
+
+@full("Explode")
+def _ex_explode():
+    from mmlspark_tpu.stages.basic import Explode
+    col = np.empty(3, object)
+    for i in range(3):
+        col[i] = list(range(i + 1))
+    return Explode(input_col="xs"), Table({"xs": col, "id": np.arange(3)})
+
+
+@full("SummarizeData")
+def _ex_summarize():
+    from mmlspark_tpu.stages.basic import SummarizeData
+    return SummarizeData(), _num_table()
+
+
+@full("ClassBalancer")
+def _ex_class_balancer():
+    from mmlspark_tpu.stages.basic import ClassBalancer
+    return ClassBalancer(input_col="label"), _num_table()
+
+
+@full("Timer")
+def _ex_timer():
+    from mmlspark_tpu.stages.basic import Timer, UDFTransformer
+    return Timer(stage=UDFTransformer(input_col="value", output_col="sq",
+                                      udf=_square)), _num_table()
+
+
+@full("UDFTransformer")
+def _ex_udf():
+    from mmlspark_tpu.stages.basic import UDFTransformer
+    return UDFTransformer(input_col="value", output_col="sq",
+                          udf=_square), _num_table()
+
+
+@full("MultiColumnAdapter")
+def _ex_mca():
+    from mmlspark_tpu.stages.basic import MultiColumnAdapter, UDFTransformer
+    inner = UDFTransformer(udf=_plus_one)
+    return MultiColumnAdapter(base_stage=inner, input_cols=["a", "b"],
+                              output_cols=["a1", "b1"]), \
+        Table({"a": np.arange(4.0), "b": np.arange(4.0) * 2})
+
+
+@full("EnsembleByKey")
+def _ex_ensemble():
+    from mmlspark_tpu.stages.basic import EnsembleByKey
+    return EnsembleByKey(keys=["k"], cols=["value"]), _num_table()
+
+
+@full("StratifiedRepartition")
+def _ex_strat():
+    from mmlspark_tpu.stages.basic import StratifiedRepartition
+    return StratifiedRepartition(n=2, label_col="label"), _num_table()
+
+
+@full("PartitionConsolidator")
+def _ex_consolidator():
+    from mmlspark_tpu.stages.basic import PartitionConsolidator
+    return PartitionConsolidator(), _num_table()
+
+
+@full("FixedMiniBatchTransformer")
+def _ex_fixed_mb():
+    from mmlspark_tpu.stages.batching import FixedMiniBatchTransformer
+    return FixedMiniBatchTransformer(batch_size=5), _num_table()
+
+
+@full("DynamicMiniBatchTransformer")
+def _ex_dyn_mb():
+    from mmlspark_tpu.stages.batching import DynamicMiniBatchTransformer
+    return DynamicMiniBatchTransformer(max_batch_size=6), _num_table()
+
+
+@full("TimeIntervalMiniBatchTransformer")
+def _ex_time_mb():
+    from mmlspark_tpu.stages.batching import TimeIntervalMiniBatchTransformer
+    return TimeIntervalMiniBatchTransformer(interval_ms=5,
+                                            max_batch_size=8), _num_table()
+
+
+@full("FlattenBatch")
+def _ex_flatten():
+    from mmlspark_tpu.stages.batching import FixedMiniBatchTransformer, FlattenBatch
+    batched = FixedMiniBatchTransformer(batch_size=5).transform(_num_table())
+    return FlattenBatch(), batched
+
+
+@full("TextPreprocessor")
+def _ex_text_pre():
+    from mmlspark_tpu.stages.text import TextPreprocessor
+    return TextPreprocessor(input_col="text", output_col="clean",
+                            map={"quick": "fast", "lazy": "idle"}), _text_table()
+
+
+@full("UnicodeNormalize")
+def _ex_unicode():
+    from mmlspark_tpu.stages.text import UnicodeNormalize
+    return UnicodeNormalize(input_col="text", output_col="norm",
+                            form="NFC", lower=True), _text_table()
+
+
+# --- image ops ---------------------------------------------------------
+
+@full("ImageTransformer")
+def _ex_image_transformer():
+    from mmlspark_tpu.ops.image_stages import ImageTransformer
+    t = ImageTransformer()
+    t.resize(8, 8).flip(flip_left_right=True)
+    return t, _img_table()
+
+
+@full("ResizeImageTransformer")
+def _ex_resize():
+    from mmlspark_tpu.ops.image_stages import ResizeImageTransformer
+    return ResizeImageTransformer(height=8, width=8), _img_table()
+
+
+@full("UnrollImage")
+def _ex_unroll():
+    from mmlspark_tpu.ops.image_stages import UnrollImage
+    return UnrollImage(), _img_table()
+
+
+@full("UnrollBinaryImage")
+def _ex_unroll_binary():
+    import io as _io
+
+    from PIL import Image
+
+    from mmlspark_tpu.ops.image_stages import UnrollBinaryImage
+    blobs = np.empty(3, object)
+    for i in range(3):
+        arr = _RNG.integers(0, 255, size=(10, 10, 3), dtype=np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        blobs[i] = buf.getvalue()
+    return UnrollBinaryImage(height=4, width=4), Table({"bytes": blobs})
+
+
+@full("ImageSetAugmenter")
+def _ex_augmenter():
+    from mmlspark_tpu.ops.image_stages import ImageSetAugmenter
+    return ImageSetAugmenter(), _img_table()
+
+
+# --- models ------------------------------------------------------------
+
+@full("TPUModel")
+def _ex_tpu_model():
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    t = Table({"x": _RNG.normal(size=(6, 32, 32, 3)).astype(np.float32)})
+    return TPUModel(bundle=_tiny_bundle(), input_col="x", output_col="y",
+                    batch_size=4), t
+
+
+@full("ImageFeaturizer")
+def _ex_image_featurizer():
+    from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+    return ImageFeaturizer(bundle=_tiny_bundle(), batch_size=4), _img_table(4)
+
+
+@full("SequenceTagger")
+def _ex_seq_tagger():
+    from mmlspark_tpu.models.bilstm import SequenceTagger
+    toks = np.empty(6, object)
+    tags = np.empty(6, object)
+    for i in range(6):
+        toks[i] = ["w%d" % (j % 4) for j in range(3 + i % 2)]
+        tags[i] = ["T%d" % (j % 2) for j in range(3 + i % 2)]
+    t = Table({"tokens": toks, "tags": tags})
+    return SequenceTagger(epochs=1, hidden=8, embed_dim=8), t
+
+
+@full("LinearRegression")
+def _ex_linreg():
+    from mmlspark_tpu.models.linear import LinearRegression
+    return LinearRegression(), _reg_table()
+
+
+@full("LogisticRegression")
+def _ex_logreg():
+    from mmlspark_tpu.models.linear import LogisticRegression
+    return LogisticRegression(max_iter=60), _cls_table()
+
+
+@full("TrainClassifier")
+def _ex_train_classifier():
+    from mmlspark_tpu.models.train_classifier import TrainClassifier
+    t = _cls_table(50)
+    return TrainClassifier(), Table({
+        "num": np.asarray(t["features"])[:, 0],
+        "cat": np.asarray(list("xy") * 25),
+        "label": t["label"],
+    })
+
+
+@full("TrainRegressor")
+def _ex_train_regressor():
+    from mmlspark_tpu.models.train_classifier import TrainRegressor
+    t = _reg_table(50)
+    return TrainRegressor(), Table({
+        "num": np.asarray(t["features"])[:, 0],
+        "num2": np.asarray(t["features"])[:, 1],
+        "label": t["label"],
+    })
+
+
+@full("ComputeModelStatistics")
+def _ex_stats():
+    from mmlspark_tpu.models.statistics import ComputeModelStatistics
+    t = Table({"label": np.array([0.0, 1.0, 1.0, 0.0]),
+               "prediction": np.array([0.0, 1.0, 0.0, 0.0]),
+               "scores": np.array([0.2, 0.9, 0.4, 0.1])})
+    return ComputeModelStatistics(evaluation_metric="classification"), t
+
+
+@full("ComputePerInstanceStatistics")
+def _ex_per_instance():
+    from mmlspark_tpu.models.statistics import ComputePerInstanceStatistics
+    t = Table({"label": np.array([1, 0]),
+               "prediction": np.array([1.0, 0.0]),
+               "scores": np.array([[0.2, 0.8], [0.7, 0.3]])})
+    return ComputePerInstanceStatistics(evaluation_metric="classification"), t
+
+
+# --- featurize ---------------------------------------------------------
+
+def _mixed_table():
+    return Table({
+        "num": np.array([1.0, np.nan, 3.0, 4.0, 2.0, np.nan]),
+        "cat": np.asarray(list("uvuvuv")),
+        "label": np.asarray(["yes", "no", "yes", "no", "yes", "no"]),
+    })
+
+
+@full("Featurize")
+def _ex_featurize():
+    from mmlspark_tpu.featurize.featurize import Featurize
+    return Featurize(input_cols=["num", "cat"], output_col="features"), \
+        _mixed_table()
+
+
+@full("ValueIndexer")
+def _ex_value_indexer():
+    from mmlspark_tpu.featurize.value_indexer import ValueIndexer
+    return ValueIndexer(input_col="label", output_col="idx"), _mixed_table()
+
+
+@full("IndexToValue")
+def _ex_index_to_value():
+    from mmlspark_tpu.featurize.value_indexer import IndexToValue, ValueIndexer
+    t = ValueIndexer(input_col="label", output_col="idx").fit(
+        _mixed_table()).transform(_mixed_table())
+    return IndexToValue(input_col="idx", output_col="back"), t
+
+
+@full("CleanMissingData")
+def _ex_clean_missing():
+    from mmlspark_tpu.featurize.clean_missing import CleanMissingData
+    return CleanMissingData(input_cols=["num"]), _mixed_table()
+
+
+@full("DataConversion")
+def _ex_data_conversion():
+    from mmlspark_tpu.featurize.featurize import DataConversion
+    return DataConversion(cols=["value"], convert_to="integer"), \
+        Table({"value": np.array([1.2, 3.9, 2.1])})
+
+
+@full("CountSelector")
+def _ex_count_selector():
+    from mmlspark_tpu.featurize.featurize import CountSelector
+    x = np.zeros((6, 3), np.float32)
+    x[:, 0] = _RNG.normal(size=6)
+    return CountSelector(input_col="features", output_col="selected"), \
+        Table({"features": x})
+
+
+@full("TextFeaturizer")
+def _ex_text_featurizer():
+    from mmlspark_tpu.featurize.text import TextFeaturizer
+    return TextFeaturizer(input_col="text", num_features=64), _text_table()
+
+
+@full("MultiNGram")
+def _ex_multingram():
+    from mmlspark_tpu.featurize.text import MultiNGram
+    toks = np.empty(3, object)
+    for i in range(3):
+        toks[i] = ["a", "b", "c", "d"][: i + 2]
+    return MultiNGram(input_col="tokens", output_col="ngrams",
+                      lengths=[1, 2]), Table({"tokens": toks})
+
+
+@full("PageSplitter")
+def _ex_page_splitter():
+    from mmlspark_tpu.featurize.text import PageSplitter
+    return PageSplitter(input_col="text", maximum_page_length=20,
+                        minimum_page_length=10), _text_table()
+
+
+# --- GBDT / online / automl -------------------------------------------
+
+@full("GBDTClassifier")
+def _ex_gbdt_cls():
+    from mmlspark_tpu.gbdt import GBDTClassifier
+    return GBDTClassifier(num_iterations=5, num_leaves=7, min_data_in_leaf=5,
+                          parallelism="serial"), _cls_table()
+
+
+@full("GBDTRegressor")
+def _ex_gbdt_reg():
+    from mmlspark_tpu.gbdt import GBDTRegressor
+    return GBDTRegressor(num_iterations=5, num_leaves=7, min_data_in_leaf=5,
+                         parallelism="serial"), _reg_table()
+
+
+@full("GBDTRanker")
+def _ex_gbdt_rank():
+    from mmlspark_tpu.gbdt import GBDTRanker
+    t = _reg_table(48)
+    group = np.repeat(np.arange(8), 6)
+    rel = (np.asarray(t["label"]) > 0).astype(np.float64)
+    return GBDTRanker(num_iterations=4, num_leaves=7, min_data_in_leaf=3), \
+        Table({"features": t["features"], "label": rel, "group": group})
+
+
+@full("VowpalWabbitClassifier")
+def _ex_vw_cls():
+    from mmlspark_tpu.online.learners import VowpalWabbitClassifier
+    return VowpalWabbitClassifier(num_passes=2), _hashed_table()
+
+
+@full("VowpalWabbitRegressor")
+def _ex_vw_reg():
+    from mmlspark_tpu.online.learners import VowpalWabbitRegressor
+    t = _hashed_table()
+    return VowpalWabbitRegressor(num_passes=2), t
+
+
+@full("VowpalWabbitFeaturizer")
+def _ex_vw_feat():
+    from mmlspark_tpu.online.featurizer import VowpalWabbitFeaturizer
+    return VowpalWabbitFeaturizer(input_cols=["text"], num_bits=10,
+                                  string_split_cols=["text"]), _text_table()
+
+
+@full("VowpalWabbitInteractions")
+def _ex_vw_inter():
+    from mmlspark_tpu.online.featurizer import (
+        VowpalWabbitFeaturizer,
+        VowpalWabbitInteractions,
+    )
+    t = Table({"a": np.arange(4.0), "b": np.arange(4.0) * 3})
+    t = VowpalWabbitFeaturizer(input_cols=["a"], output_col="na",
+                               num_bits=10).transform(t)
+    t = VowpalWabbitFeaturizer(input_cols=["b"], output_col="nb",
+                               num_bits=10).transform(t)
+    return VowpalWabbitInteractions(input_cols=["na", "nb"], num_bits=10), t
+
+
+@full("VectorZipper")
+def _ex_vector_zipper():
+    from mmlspark_tpu.online.featurizer import VectorZipper
+    return VectorZipper(input_cols=["value", "k"], output_col="zipped"), \
+        _num_table()
+
+
+@full("VowpalWabbitContextualBandit")
+def _ex_cb():
+    from mmlspark_tpu.online.contextual_bandit import VowpalWabbitContextualBandit
+    from mmlspark_tpu.online.hashing import FeatureHasher
+    rng = np.random.default_rng(5)
+    h = FeatureHasher(12, 0)
+    n, d, num_actions = 30, 3, 3
+    shared_rows = np.empty(n, object)
+    action_rows = np.empty(n, object)
+    chosen = np.zeros(n, np.int64)
+    cost = np.zeros(n)
+    prob = np.full(n, 1.0 / num_actions)
+    for i in range(n):
+        idx = np.array([h("s", f"f{j}") for j in range(d)], np.uint32)
+        vals = rng.normal(size=d).astype(np.float32)
+        shared_rows[i] = (idx, vals)
+        acts = []
+        for a in range(num_actions):
+            aidx = np.array([h(f"act{a}", f"x{j}") for j in range(d)], np.uint32)
+            acts.append((aidx, vals))
+        action_rows[i] = acts
+        chosen[i] = int(rng.integers(num_actions)) + 1
+        cost[i] = float(rng.normal())
+    t = Table({"shared": shared_rows, "features": action_rows,
+               "chosen_action": chosen, "cost": cost, "probability": prob})
+    return VowpalWabbitContextualBandit(num_passes=2, num_bits=12), t
+
+
+@full("TuneHyperparameters")
+def _ex_tune():
+    from mmlspark_tpu.automl import (
+        DiscreteHyperParam,
+        GridSpace,
+        HyperparamBuilder,
+        TuneHyperparameters,
+    )
+    from mmlspark_tpu.models.linear import LogisticRegression
+    space = (HyperparamBuilder()
+             .add_hyperparam("reg_param", DiscreteHyperParam([1e-4, 1.0]))
+             .build())
+    return TuneHyperparameters(models=[LogisticRegression(max_iter=20)],
+                               param_space=GridSpace(space),
+                               evaluation_metric="accuracy", num_folds=2,
+                               parallelism=1, seed=2), _cls_table(40)
+
+
+@full("FindBestModel")
+def _ex_find_best():
+    from mmlspark_tpu.automl.find_best import FindBestModel
+    from mmlspark_tpu.models.linear import LogisticRegression
+    t = _cls_table(40)
+    m1 = LogisticRegression(max_iter=40).fit(t)
+    m2 = LogisticRegression(max_iter=1, learning_rate=1e-6).fit(t)
+    return FindBestModel(models=[m2, m1], evaluation_metric="accuracy"), t
+
+
+# --- explainers / nn / recommendation / iforest / cyber ----------------
+
+def _lambda_linear_model():
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+
+    return LambdaTransformer(_linear_score_fn)
+
+
+def _linear_score_fn(t):
+    from mmlspark_tpu.core.schema import features_matrix
+
+    x = features_matrix(t["features"])
+    w = np.array([2.0, -3.0, 0.5], np.float32)[: x.shape[1]]
+    return t.with_column("scores", x @ w)
+
+
+@full("TabularLIME")
+def _ex_tab_lime():
+    from mmlspark_tpu.explainers.tabular import TabularLIME
+    t = Table({"features": _RNG.normal(size=(4, 3)).astype(np.float32)})
+    return TabularLIME(model=_lambda_linear_model(), num_samples=32,
+                       seed=1), t
+
+
+@full("TabularSHAP")
+def _ex_tab_shap():
+    from mmlspark_tpu.explainers.tabular import TabularSHAP
+    t = Table({"features": _RNG.normal(size=(3, 3)).astype(np.float32)})
+    return TabularSHAP(model=_lambda_linear_model(), num_samples=32,
+                       seed=2), t
+
+
+@full("VectorLIME")
+def _ex_vec_lime():
+    from mmlspark_tpu.explainers.tabular import VectorLIME
+    t = Table({"features": _RNG.normal(size=(3, 3)).astype(np.float32)})
+    return VectorLIME(model=_lambda_linear_model(), num_samples=32, seed=3), t
+
+
+@full("VectorSHAP")
+def _ex_vec_shap():
+    from mmlspark_tpu.explainers.tabular import VectorSHAP
+    t = Table({"features": _RNG.normal(size=(3, 3)).astype(np.float32)})
+    return VectorSHAP(model=_lambda_linear_model(), num_samples=32, seed=4), t
+
+
+def _brightness_fn(t):
+    vals = np.array([np.asarray(r).mean() for r in t["image"]])
+    return t.with_column("scores", vals)
+
+
+def _image_model():
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+
+    return LambdaTransformer(_brightness_fn)
+
+
+def _float_img_table(n=2):
+    imgs = np.empty(n, object)
+    for i in range(n):
+        imgs[i] = _RNG.random((24, 24, 3)).astype(np.float32)
+    return Table({"image": imgs})
+
+
+@full("ImageLIME")
+def _ex_img_lime():
+    from mmlspark_tpu.explainers.image import ImageLIME
+    return ImageLIME(model=_image_model(), num_samples=16, seed=5,
+                     cell_size=8.0), _float_img_table()
+
+
+@full("ImageSHAP")
+def _ex_img_shap():
+    from mmlspark_tpu.explainers.image import ImageSHAP
+    return ImageSHAP(model=_image_model(), num_samples=16, seed=6,
+                     cell_size=8.0), _float_img_table()
+
+
+def _keyword_fn(t):
+    vals = np.array([float("fox" in s) for s in t["text"]])
+    return t.with_column("scores", vals)
+
+
+def _text_model():
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+
+    return LambdaTransformer(_keyword_fn)
+
+
+@full("TextLIME")
+def _ex_text_lime():
+    from mmlspark_tpu.explainers.text import TextLIME
+    return TextLIME(model=_text_model(), num_samples=16, seed=7), \
+        Table({"text": np.asarray(["the quick fox", "a lazy dog"], object)})
+
+
+@full("TextSHAP")
+def _ex_text_shap():
+    from mmlspark_tpu.explainers.text import TextSHAP
+    return TextSHAP(model=_text_model(), num_samples=16, seed=8), \
+        Table({"text": np.asarray(["the quick fox", "a lazy dog"], object)})
+
+
+@full("SuperpixelTransformer")
+def _ex_superpixel():
+    from mmlspark_tpu.explainers.superpixel import SuperpixelTransformer
+    return SuperpixelTransformer(input_col="image", cell_size=8.0), \
+        _float_img_table()
+
+
+@full("KNN")
+def _ex_knn():
+    from mmlspark_tpu.nn.knn import KNN
+    t = Table({"features": _RNG.normal(size=(20, 3)).astype(np.float32),
+               "values": np.arange(20.0)})
+    return KNN(k=2), t
+
+
+@full("ConditionalKNN")
+def _ex_cknn():
+    from mmlspark_tpu.nn.knn import ConditionalKNN
+    conds = np.empty(20, object)
+    for i in range(20):
+        conds[i] = {0, 1}
+    t = Table({"features": _RNG.normal(size=(20, 3)).astype(np.float32),
+               "values": np.arange(20.0),
+               "labels": np.asarray([i % 2 for i in range(20)]),
+               "conditioner": conds})
+    return ConditionalKNN(k=2, label_col="labels"), t
+
+
+@full("SAR")
+def _ex_sar():
+    from mmlspark_tpu.recommendation.sar import SAR
+    return SAR(support_threshold=1), _ratings_table()
+
+
+@full("RecommendationIndexer")
+def _ex_rec_indexer():
+    from mmlspark_tpu.recommendation.indexer import RecommendationIndexer
+    t = Table({"user": np.asarray(["u1", "u2", "u1", "u3"]),
+               "item": np.asarray(["a", "b", "c", "a"]),
+               "rating": np.array([1.0, 2.0, 3.0, 4.0])})
+    return RecommendationIndexer(user_input_col="user", item_input_col="item",
+                                 user_output_col="user_idx",
+                                 item_output_col="item_idx"), t
+
+
+@full("RankingAdapter")
+def _ex_ranking_adapter():
+    from mmlspark_tpu.recommendation.ranking import RankingAdapter
+    from mmlspark_tpu.recommendation.sar import SAR
+    return RankingAdapter(recommender=SAR(support_threshold=1), k=3), \
+        _ratings_table()
+
+
+@full("RankingTrainValidationSplit")
+def _ex_tvs():
+    from mmlspark_tpu.recommendation.ranking import RankingEvaluator
+    from mmlspark_tpu.recommendation.sar import SAR
+    from mmlspark_tpu.recommendation.tvs import RankingTrainValidationSplit
+    return RankingTrainValidationSplit(
+        estimator=SAR(support_threshold=1),
+        param_grid=[{"similarity_function": "jaccard"}],
+        evaluator=RankingEvaluator(metric_name="ndcgAt", k=3),
+        train_ratio=0.75, seed=2), _ratings_table()
+
+
+@full("IsolationForest")
+def _ex_iforest():
+    from mmlspark_tpu.isolationforest.iforest import IsolationForest
+    t = Table({"features": _RNG.normal(size=(60, 3)).astype(np.float32)})
+    return IsolationForest(num_estimators=10, max_samples=32), t
+
+
+@full("AccessAnomaly")
+def _ex_access_anomaly():
+    from mmlspark_tpu.cyber.access_anomaly import AccessAnomaly
+    rng = np.random.default_rng(9)
+    n = 80
+    return AccessAnomaly(rank=3, max_iter=3), Table({
+        "tenant": np.zeros(n, np.int64),
+        "user": rng.integers(0, 10, n).astype(np.int64),
+        "res": rng.integers(0, 8, n).astype(np.int64),
+    })
+
+
+@full("ComplementAccessTransformer")
+def _ex_complement():
+    from mmlspark_tpu.cyber.access_anomaly import ComplementAccessTransformer
+    rng = np.random.default_rng(10)
+    n = 20
+    return ComplementAccessTransformer(complement_ratio=1.0, seed=5), Table({
+        "tenant": np.zeros(n, np.int64),
+        "user": rng.integers(0, 5, n).astype(np.int64),
+        "res": rng.integers(0, 5, n).astype(np.int64),
+    })
+
+
+@full("IdIndexer")
+def _ex_id_indexer():
+    from mmlspark_tpu.cyber.feature import IdIndexer
+    rng = np.random.default_rng(11)
+    n = 20
+    return IdIndexer(input_col="user", partition_key="tenant",
+                     output_col="user_idx"), Table({
+                         "tenant": rng.integers(0, 2, n).astype(np.int64),
+                         "user": rng.integers(0, 6, n).astype(np.int64),
+                     })
+
+
+@full("PartitionedStandardScaler")
+def _ex_pstd_scaler():
+    from mmlspark_tpu.cyber.feature import PartitionedStandardScaler
+    rng = np.random.default_rng(12)
+    n = 24
+    return PartitionedStandardScaler(input_col="value", partition_key="tenant",
+                                     output_col="scaled"), Table({
+                                         "tenant": rng.integers(0, 2, n).astype(np.int64),
+                                         "value": rng.normal(size=n),
+                                     })
+
+
+@full("PartitionedMinMaxScaler")
+def _ex_pminmax_scaler():
+    from mmlspark_tpu.cyber.feature import PartitionedMinMaxScaler
+    rng = np.random.default_rng(13)
+    n = 24
+    return PartitionedMinMaxScaler(input_col="value", partition_key="tenant",
+                                   output_col="scaled"), Table({
+                                       "tenant": rng.integers(0, 2, n).astype(np.int64),
+                                       "value": rng.normal(size=n),
+                                   })
+
+
+# --- HTTP parsers (local, no network) ----------------------------------
+
+@full("JSONInputParser")
+def _ex_json_input():
+    from mmlspark_tpu.io.http.transformers import JSONInputParser
+    return JSONInputParser(input_cols=["a"], url="http://localhost:9/x"), \
+        Table({"a": np.array([1, 2, 3])})
+
+
+@full("CustomInputParser")
+def _ex_custom_input():
+    from mmlspark_tpu.io.http.transformers import CustomInputParser
+    return CustomInputParser(input_cols=["a"], udf=_row_to_request), \
+        Table({"a": np.array([1, 2])})
+
+
+@full("JSONOutputParser")
+def _ex_json_output():
+    from mmlspark_tpu.io.http.transformers import JSONOutputParser
+    return JSONOutputParser(), _fake_responses_table()
+
+
+@full("StringOutputParser")
+def _ex_string_output():
+    from mmlspark_tpu.io.http.transformers import StringOutputParser
+    return StringOutputParser(), _fake_responses_table()
+
+
+@full("CustomOutputParser")
+def _ex_custom_output():
+    from mmlspark_tpu.io.http.transformers import CustomOutputParser
+    return CustomOutputParser(udf=_response_status), _fake_responses_table()
+
+
+# ----------------------------------------------------------------------
+# SERDE-only bucket: network transformers — transform needs a live
+# endpoint; behavior is mock-server-tested in test_cognitive.py /
+# test_http_serving.py.  Factories return just the stage.
+# ----------------------------------------------------------------------
+
+_COG_URL = "http://localhost:9/api"
+_COG = {"url": _COG_URL, "subscription_key": "k"}
+
+SERDE = {}
+
+
+def serde(name, reason="transform needs a live HTTP endpoint; "
+          "mock-server transform tests live in test_cognitive.py"):
+    def wrap(fn):
+        SERDE[name] = (fn, reason)
+        return fn
+    return wrap
+
+
+def _serde_cognitive(name, **extra):
+    import mmlspark_tpu.cognitive as cog
+
+    cls = getattr(cog, name)
+
+    def factory():
+        return cls(**{**_COG, **extra})
+    serde(name)(factory)
+    return factory
+
+
+for _n in ["AnalyzeInvoices", "AnalyzeLayout", "BreakSentence", "Detect",
+           "DetectAnomalies", "DetectLastAnomaly", "DocumentTranslator",
+           "SpeechToText", "Translate", "Transliterate", "EntityDetector",
+           "KeyPhraseExtractor", "LanguageDetector", "NER", "PII",
+           "TextSentiment", "AnalyzeImage", "DescribeImage", "DetectFace",
+           "FindSimilarFace", "GenerateThumbnails", "GroupFaces",
+           "IdentifyFaces", "OCR", "ReadImage",
+           "RecognizeDomainSpecificContent", "TagImage", "VerifyFaces"]:
+    _serde_cognitive(_n)
+
+
+@serde("BingImageSearch")
+def _ex_bing():
+    from mmlspark_tpu.cognitive.services import BingImageSearch
+    return BingImageSearch(url=_COG_URL, subscription_key="k", count=2)
+
+
+@serde("HTTPTransformer",
+       reason="sends requests over the network; echo-server transform tests "
+              "live in test_http_serving.py")
+def _ex_http_transformer():
+    from mmlspark_tpu.io.http.transformers import HTTPTransformer
+    return HTTPTransformer(concurrency=2)
+
+
+@serde("SimpleHTTPTransformer",
+       reason="sends requests over the network; echo-server transform tests "
+              "live in test_http_serving.py")
+def _ex_simple_http():
+    from mmlspark_tpu.io.http.transformers import SimpleHTTPTransformer
+    return SimpleHTTPTransformer(input_cols=["a"], url=_COG_URL)
+
+
+# ----------------------------------------------------------------------
+# Model classes covered via their estimator's FULL example
+# ----------------------------------------------------------------------
+
+VIA_ESTIMATOR = {
+    "BestModel": "FindBestModel",
+    "TuneHyperparametersModel": "TuneHyperparameters",
+    "AccessAnomalyModel": "AccessAnomaly",
+    "IdIndexerModel": "IdIndexer",
+    "PartitionedScalerModel": "PartitionedMinMaxScaler",
+    "CleanMissingDataModel": "CleanMissingData",
+    "CountSelectorModel": "CountSelector",
+    "FeaturizeModel": "Featurize",
+    "TextFeaturizerModel": "TextFeaturizer",
+    "ValueIndexerModel": "ValueIndexer",
+    "GBDTClassificationModel": "GBDTClassifier",
+    "GBDTRegressionModel": "GBDTRegressor",
+    "GBDTRankerModel": "GBDTRanker",
+    "IsolationForestModel": "IsolationForest",
+    "SequenceTaggerModel": "SequenceTagger",
+    "LinearRegressionModel": "LinearRegression",
+    "LogisticRegressionModel": "LogisticRegression",
+    "TrainedClassifierModel": "TrainClassifier",
+    "TrainedRegressorModel": "TrainRegressor",
+    "KNNModel": "KNN",
+    "ConditionalKNNModel": "ConditionalKNN",
+    "VowpalWabbitClassificationModel": "VowpalWabbitClassifier",
+    "VowpalWabbitRegressionModel": "VowpalWabbitRegressor",
+    "VowpalWabbitContextualBanditModel": "VowpalWabbitContextualBandit",
+    "RecommendationIndexerModel": "RecommendationIndexer",
+    "RankingAdapterModel": "RankingAdapter",
+    "SARModel": "SAR",
+    "RankingTrainValidationSplitModel": "RankingTrainValidationSplit",
+    "ClassBalancerModel": "ClassBalancer",
+    "TimerModel": "Timer",
+}
+
+
+# ----------------------------------------------------------------------
+# the sweep
+# ----------------------------------------------------------------------
+
+def _canonical_names():
+    """Registry names deduped by class (aliases like LightGBMClassifier map
+    to the same class as GBDTClassifier and count as covered with it)."""
+    stages = registry.all_stages()
+    by_class = {}
+    for name, cls in stages.items():
+        by_class.setdefault(cls, []).append(name)
+    return stages, by_class
+
+
+def test_every_registered_stage_is_covered():
+    """The FuzzingTest.scala sweep: fail for any registry class in no
+    bucket, and for any bucket entry not in the registry."""
+    stages, by_class = _canonical_names()
+    covered = set(FULL) | set(SERDE) | set(VIA_ESTIMATOR)
+    uncovered = []
+    for cls, names in by_class.items():
+        if not any(n in covered for n in names):
+            uncovered.append("/".join(sorted(names)))
+    assert not uncovered, (
+        f"{len(uncovered)} registered stages have no fuzzing example "
+        f"(add to FULL/SERDE/VIA_ESTIMATOR in test_fuzzing_coverage.py): "
+        f"{sorted(uncovered)}")
+    stale = [n for n in covered if n not in stages]
+    assert not stale, f"bucket entries not in the registry: {sorted(stale)}"
+
+
+def test_via_estimator_entries_point_at_full_examples():
+    stages = registry.all_stages()
+    for model_name, est_name in VIA_ESTIMATOR.items():
+        assert issubclass(stages[model_name], Model), model_name
+        assert est_name in FULL, (
+            f"{model_name} claims coverage via {est_name}, which has no "
+            "FULL example")
+
+
+@pytest.mark.parametrize("name", sorted(FULL))
+def test_fuzz_full(name):
+    stage, table = FULL[name]()
+    result = fuzz(stage, table)
+    if isinstance(stage, Estimator):
+        model, _ = result
+        # if a VIA_ESTIMATOR model claims this estimator, the fitted model
+        # must actually be of that class
+        claimed = [m for m, e in VIA_ESTIMATOR.items() if e == name]
+        if claimed:
+            stages = registry.all_stages()
+            assert any(isinstance(model, stages[m]) for m in claimed), (
+                f"{name} produced {type(model).__name__}, expected one of "
+                f"{claimed}")
+
+
+@pytest.mark.parametrize("name", sorted(SERDE))
+def test_fuzz_serde(name):
+    factory, reason = SERDE[name]
+    assert reason
+    stage = factory()
+    loaded = roundtrip(stage)
+    check_params_equal(stage, loaded)

@@ -78,6 +78,28 @@ def test_csr_rejects_out_of_range_indices():
         CSRMatrix.from_pairs_column(col, num_features=4)
 
 
+def test_csr_rejects_out_of_range_even_with_duplicates():
+    """Validation must precede dedup keying — wrapped keys would otherwise
+    scatter out-of-range entries into wrong (row, feature) cells."""
+    col = np.empty(2, object)
+    col[0] = (np.array([3, 3, 7], np.uint32), np.array([1.0, 2.0, 9.0], np.float32))
+    col[1] = (np.array([2], np.uint32), np.array([5.0], np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        CSRMatrix.from_pairs_column(col, num_features=6)
+
+
+def test_sparse_mapper_rejects_nan_everywhere():
+    x = np.array([[1.0, 0.0], [0.0, 2.0], [3.0, 0.0]])
+    csr_ok = CSRMatrix.from_dense(x)
+    m = SparseBinMapper(max_bin=7).fit(csr_ok)
+    bad = CSRMatrix(np.array([np.nan]), np.array([0]), np.array([0, 1, 1, 1]),
+                    (3, 2))
+    with pytest.raises(ValueError, match="NaN"):
+        m.transform(bad)
+    with pytest.raises(ValueError, match="NaN"):
+        SparseBinMapper(max_bin=7).fit(bad)
+
+
 # ---- binning + view ----------------------------------------------------
 
 def test_sparse_binned_view_matches_dense_codes():
