@@ -290,9 +290,22 @@ class Booster:
                 and init_model.bin_mapper is not None:
             # warm start inherits the bin boundaries/categorical codes so
             # inherited trees' threshold_bin stay valid on this data
+            if sparse != isinstance(init_model.bin_mapper, SparseBinMapper):
+                raise ValueError(
+                    "warm start requires matching representations: the "
+                    "init_model was trained "
+                    + ("dense" if sparse else "sparse")
+                    + " but this fit received "
+                    + ("CSRMatrix" if sparse else "dense") + " input")
             self.bin_mapper = init_model.bin_mapper
         if self.bin_mapper is None:
             if sparse:
+                if cfg.categorical_features:
+                    raise ValueError(
+                        "categorical_features are not supported on the "
+                        "sparse (CSRMatrix) path — hashed features are "
+                        "already indicator/count-valued; densify or drop "
+                        "the categorical declaration")
                 # CSR ingestion (DatasetAggregator.scala sparse variant):
                 # bins capped so the [F, B, 3] histogram fits device memory
                 self.bin_mapper = SparseBinMapper(

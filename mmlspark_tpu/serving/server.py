@@ -73,6 +73,11 @@ class WorkerServer:
         self.routing: Dict[str, CachedRequest] = {}
         self._routing_lock = threading.Lock()
         self.handler_timeout = handler_timeout
+        # epoch-scoped request history for replay-on-retry + commit GC
+        # (HTTPSourceV2.scala historyQueues :488-505, commit :555-567)
+        self.epoch = 0
+        self.history: Dict[int, List[CachedRequest]] = {}
+        self._epoch_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -129,20 +134,68 @@ class WorkerServer:
         with self._routing_lock:
             self.routing.pop(request_id, None)
 
-    def get_batch(self, max_batch: int, timeout_ms: float) -> List[CachedRequest]:
+    def get_batch(self, max_batch: int, timeout_ms: float,
+                  block: bool = True) -> List[CachedRequest]:
         """Drain up to max_batch requests; blocks up to timeout_ms for the
-        first one (continuous-batching feed)."""
+        first one (continuous-batching feed).  `block=False` drains only
+        what is already queued (the microbatch-trigger feed)."""
         out: List[CachedRequest] = []
-        try:
-            out.append(self.queue.get(timeout=timeout_ms / 1000.0))
-        except Empty:
-            return out
+        if block:
+            try:
+                out.append(self.queue.get(timeout=timeout_ms / 1000.0))
+            except Empty:
+                return out
         while len(out) < max_batch:
             try:
                 out.append(self.queue.get_nowait())
             except Empty:
                 break
         return out
+
+    def get_epoch_batch(self, max_batch: int, timeout_ms: float,
+                        block: bool = True):
+        """(epoch, batch): drain a batch and record it under a fresh epoch
+        so an uncommitted consumer death can replay it (the reference's
+        per-epoch requestQueues, HTTPSourceV2.scala:646-661)."""
+        batch = self.get_batch(max_batch, timeout_ms, block=block)
+        with self._epoch_lock:
+            self.epoch += 1
+            epoch = self.epoch
+            if batch:
+                self.history[epoch] = list(batch)
+        return epoch, batch
+
+    def commit(self, epoch: int):
+        """Answered epochs need no replay: GC their history
+        (HTTPSinkV2.scala:112 commit -> HTTPSourceV2 :555-567)."""
+        with self._epoch_lock:
+            for e in [e for e in self.history if e <= epoch]:
+                del self.history[e]
+
+    def recover(self, max_attempts: Optional[int] = None) -> int:
+        """Replay every unanswered request of every uncommitted epoch
+        (recoveredPartitions, HTTPSourceV2.scala:488-505,608-613).  Returns
+        the number of requests requeued.  Answered requests in uncommitted
+        epochs are dropped from history, not replayed twice.  With
+        `max_attempts`, requests that already burned their retries are
+        answered 500 instead of requeued — otherwise a poison batch that
+        kills the consumer would crash-loop forever."""
+        with self._epoch_lock:
+            epochs = sorted(self.history)
+            replay: List[CachedRequest] = []
+            for e in epochs:
+                replay.extend(r for r in self.history[e] if not r.done.is_set())
+                del self.history[e]
+        requeued = 0
+        for req in replay:
+            if max_attempts is not None and req.attempts + 1 >= max_attempts:
+                self.reply_to(req.id, HTTPResponseData(
+                    500, "consumer died", {},
+                    b'{"error": "consumer died processing this request"}'))
+            else:
+                self.requeue(req)
+                requeued += 1
+        return requeued
 
     def requeue(self, req: CachedRequest):
         """Replay a failed request (historyQueues/recoveredPartitions)."""
@@ -223,23 +276,42 @@ class ServingServer:
 
     model: a Transformer whose transform consumes the parsed request columns
     and produces `reply_col`.
+
+    Engine modes (the reference's trigger duality, SURVEY §2.4 #29):
+      - "continuous": a long-running consumer blocks on the queue and drains
+        opportunistic batches — the sub-ms path (HTTPSourceV2 continuous).
+      - "microbatch": the consumer wakes every `trigger_interval_ms`, drains
+        everything that arrived, processes, commits (HTTPSource V1 offsets-
+        as-request-counts semantics).
+
+    Every drained batch is an epoch recorded in the server's history;
+    commit happens only after all replies are written, so a consumer death
+    mid-batch replays the unanswered requests: a supervisor thread restarts
+    the loop and calls `recover()` (the Spark task-retry analog).
     """
 
     def __init__(self, model, reply_col: str, name: str = "serving",
                  host: str = "127.0.0.1", port: int = 0, path: str = "/",
                  input_schema: Optional[List[str]] = None,
                  max_batch: int = 64, batch_timeout_ms: float = 10.0,
-                 max_attempts: int = 2):
+                 max_attempts: int = 2, mode: str = "continuous",
+                 trigger_interval_ms: float = 20.0):
+        if mode not in ("continuous", "microbatch"):
+            raise ValueError("mode must be 'continuous' or 'microbatch'")
         self.model = model
         self.reply_col = reply_col
         self.input_schema = input_schema
         self.max_batch = int(max_batch)
         self.batch_timeout_ms = float(batch_timeout_ms)
         self.max_attempts = int(max_attempts)
+        self.mode = mode
+        self.trigger_interval_ms = float(trigger_interval_ms)
         self.server = WorkerServer(name, host, port, path)
         self._running = threading.Event()
         self._worker: Optional[threading.Thread] = None
-        self.stats = {"requests": 0, "batches": 0, "errors": 0}
+        self._supervisor: Optional[threading.Thread] = None
+        self.stats = {"requests": 0, "batches": 0, "errors": 0,
+                      "recoveries": 0, "replayed": 0}
 
     @property
     def service_info(self) -> ServiceInfo:
@@ -247,8 +319,15 @@ class ServingServer:
 
     def _loop(self):
         while self._running.is_set():
-            batch = self.server.get_batch(self.max_batch, self.batch_timeout_ms)
+            if self.mode == "microbatch":
+                time.sleep(self.trigger_interval_ms / 1000.0)
+                epoch, batch = self.server.get_epoch_batch(
+                    self.max_batch, 0, block=False)
+            else:
+                epoch, batch = self.server.get_epoch_batch(
+                    self.max_batch, self.batch_timeout_ms)
             if not batch:
+                self.server.commit(epoch)  # empty epochs GC immediately
                 continue
             try:
                 table, id_col = parse_request(batch, self.input_schema)
@@ -256,9 +335,12 @@ class ServingServer:
                 make_reply(out, self.reply_col, self.server, id_col=id_col)
                 self.stats["requests"] += len(batch)
                 self.stats["batches"] += 1
+                self.server.commit(epoch)
             except Exception as e:  # noqa: BLE001 — serving must survive
                 self.stats["errors"] += 1
                 for req in batch:
+                    if req.done.is_set():
+                        continue  # make_reply answered it before failing
                     if req.attempts + 1 < self.max_attempts:
                         self.server.requeue(req)
                     else:
@@ -269,6 +351,19 @@ class ServingServer:
                                 json.dumps({"error": str(e)}).encode(),
                             ),
                         )
+                self.server.commit(epoch)  # requeued/answered: history done
+
+    def _supervise(self):
+        """Restart a dead consumer and replay its uncommitted epochs —
+        the Spark task-retry + recoveredPartitions path."""
+        while self._running.is_set():
+            time.sleep(0.05)
+            if self._running.is_set() and not self._worker.is_alive():
+                self.stats["recoveries"] += 1
+                self.stats["replayed"] += self.server.recover(self.max_attempts)
+                self._worker = threading.Thread(
+                    target=self._loop, daemon=True, name="serving-batch-loop")
+                self._worker.start()
 
     def start(self) -> ServiceInfo:
         self.server.start()
@@ -276,10 +371,15 @@ class ServingServer:
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-batch-loop")
         self._worker.start()
+        self._supervisor = threading.Thread(target=self._supervise, daemon=True,
+                                            name="serving-supervisor")
+        self._supervisor.start()
         return self.service_info
 
     def stop(self):
         self._running.clear()
         if self._worker is not None:
             self._worker.join(timeout=5)
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
         self.server.stop()

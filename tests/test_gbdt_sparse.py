@@ -231,6 +231,28 @@ def test_sparse_eval_early_stopping_and_leaf_shap():
     assert np.allclose(shap.sum(axis=1), raw, atol=1e-6)
 
 
+def test_warm_start_representation_mismatch_raises():
+    x, y = _sparse_data(n=200, f=10)
+    cfg = TrainConfig(objective="binary", num_iterations=3, num_leaves=7,
+                      min_data_in_leaf=5, parallelism="serial")
+    dense = Booster(cfg).fit(x, y)
+    with pytest.raises(ValueError, match="matching representations"):
+        Booster(TrainConfig(**vars(cfg))).fit(
+            CSRMatrix.from_dense(x), y, init_model=dense)
+    sparse = Booster(TrainConfig(**vars(cfg))).fit(CSRMatrix.from_dense(x), y)
+    with pytest.raises(ValueError, match="matching representations"):
+        Booster(TrainConfig(**vars(cfg))).fit(x, y, init_model=sparse)
+
+
+def test_sparse_rejects_categorical_features():
+    x, y = _sparse_data(n=100, f=8)
+    cfg = TrainConfig(objective="binary", num_iterations=2, num_leaves=7,
+                      min_data_in_leaf=5, parallelism="serial",
+                      categorical_features=[2])
+    with pytest.raises(ValueError, match="categorical"):
+        Booster(cfg).fit(CSRMatrix.from_dense(x), y)
+
+
 def test_sparse_model_string_roundtrip():
     x, y = _sparse_data(n=300, f=15)
     csr = CSRMatrix.from_dense(x)
