@@ -1,0 +1,63 @@
+"""Worker for the multi-process distributed test (launched by
+test_distributed_multiprocess.py): joins the jax.distributed rendezvous,
+gang-syncs, and runs a cross-process psum.
+
+Reference semantics being proven: the driver-socket rendezvous + barrier +
+ring AllReduce control plane (lightgbm/LightGBMBase.scala:392-430,
+TrainUtils.scala:259-266) rebuilt on jax.distributed's coordination
+service, with collectives crossing real process boundaries.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+
+    from mmlspark_tpu.parallel.distributed import (
+        barrier,
+        initialize_distributed,
+        is_coordinator,
+    )
+
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    addr = sys.argv[3]
+
+    initialize_distributed(coordinator_address=addr, num_processes=nproc,
+                           process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.process_index() == pid, jax.process_index()
+    assert jax.device_count() == 2 * nproc, jax.device_count()
+    assert is_coordinator() == (pid == 0)
+
+    barrier()
+
+    # data-plane proof: a psum over ALL devices of ALL processes
+    out = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(
+        np.ones((jax.local_device_count(),)))
+    total = float(np.asarray(out)[0])
+    assert total == 2 * nproc, total
+
+    # weighted mean across processes (the VW end-of-pass AllReduce shape,
+    # vw/VowpalWabbitBase.scala:434-462): every process contributes its rank
+    contrib = np.full((jax.local_device_count(), 4), float(pid))
+    summed = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(contrib)
+    mean = np.asarray(summed)[0] / jax.device_count()
+    expect = sum(range(nproc)) * 2 / (2 * nproc)
+    assert np.allclose(mean, expect), (mean, expect)
+
+    print(f"WORKER_OK pid={pid} psum={total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
