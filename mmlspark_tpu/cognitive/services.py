@@ -13,6 +13,7 @@ from urllib.parse import urlencode
 from ..core.params import Param, ServiceParam, TypeConverters
 from ..core.registry import register_stage
 from ..core.schema import Table
+from ..io.http.schema import HTTPRequestData
 from .base import BasicAsyncReply, CognitiveServicesBase
 from .vision import HasImageInput
 
@@ -20,12 +21,21 @@ __all__ = [
     "SpeechToText",
     "DetectLastAnomaly",
     "DetectAnomalies",
+    "SimpleDetectAnomalies",
     "Translate",
     "Detect",
     "BreakSentence",
     "Transliterate",
+    "DictionaryLookup",
+    "DictionaryExamples",
     "AnalyzeLayout",
     "AnalyzeInvoices",
+    "AnalyzeReceipts",
+    "AnalyzeBusinessCards",
+    "AnalyzeIDDocuments",
+    "AnalyzeCustomModel",
+    "GetCustomModel",
+    "ListCustomModels",
     "DocumentTranslator",
     "BingImageSearch",
 ]
@@ -91,6 +101,101 @@ class DetectAnomalies(_AnomalyBase):
     _path = "/anomalydetector/v1.0/timeseries/entire/detect"
 
 
+@register_stage
+class SimpleDetectAnomalies(CognitiveServicesBase):
+    """Row-wise anomaly detection with grouping (AnomalyDetection.scala:249
+    SimpleDetectAnomalies): rows carry (timestamp, value, group); each group
+    becomes ONE entire-series request sorted by timestamp, and the per-point
+    verdict joins back onto its row."""
+
+    _path = "/anomalydetector/v1.0/timeseries/entire/detect"
+    timestamp_col = Param("per-row timestamp column", default="timestamp")
+    value_col = Param("per-row value column", default="value")
+    group_col = Param("series grouping column", default="group")
+    granularity = ServiceParam("series granularity", default="daily")
+    sensitivity = ServiceParam("sensitivity 0-99", default=None)
+
+    def _prepare_entity(self, table, i):  # driven by the grouped _transform
+        raise NotImplementedError
+
+    @staticmethod
+    def _ts_key(v):
+        """Chronological sort key: numerics numerically, ISO-8601 via
+        datetime parsing (lexicographic order misorders epoch ints and
+        non-zero-padded dates; the service 400s on unsorted series)."""
+        import datetime as _dt
+
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return (0, float(v), "")
+        s = str(v)
+        try:
+            return (0, float(s), "")
+        except ValueError:
+            pass
+        try:
+            return (0, _dt.datetime.fromisoformat(
+                s.replace("Z", "+00:00")).timestamp(), "")
+        except ValueError:
+            return (1, 0.0, s)
+
+    def _transform(self, table: Table) -> Table:
+        import math
+
+        import numpy as np
+
+        n = len(table)
+        groups = table[self.group_col]
+        ts = table[self.timestamp_col]
+        vals = table[self.value_col]
+        skipped = np.zeros(n, bool)
+        order_of: Dict[object, List[int]] = {}
+        for i in range(n):
+            v = vals[i]
+            # base-class contract: a null row is skipped (null output), not
+            # a crash — and it must not poison its whole group's series
+            if ts[i] is None or v is None or (
+                    isinstance(v, float) and math.isnan(v)):
+                skipped[i] = True
+                continue
+            order_of.setdefault(groups[i], []).append(i)
+
+        reqs, row_maps = [], []
+        for g, rows in order_of.items():
+            rows = sorted(rows, key=lambda r: self._ts_key(ts[r]))
+            series = [{"timestamp": str(ts[r]), "value": float(vals[r])}
+                      for r in rows]
+            body = {"series": series,
+                    "granularity": self.resolve("granularity", table, rows[0])}
+            sens = self.resolve("sensitivity", table, rows[0])
+            if sens is not None:
+                body["sensitivity"] = int(sens)
+            reqs.append(HTTPRequestData(
+                url=self._prepare_url(table, rows[0]), method="POST",
+                headers=self._headers(table, rows[0]),
+                entity=json.dumps(body).encode()))
+            row_maps.append(rows)
+
+        resps = self._client().send_all(reqs)
+        out = np.empty(n, dtype=object)
+        errs = np.empty(n, dtype=object)
+        errs[:] = None
+        for rows, resp in zip(row_maps, resps):
+            if resp is None or not resp.ok:
+                msg = None if resp is None else f"{resp.status_code} {resp.reason}"
+                for r in rows:
+                    errs[r] = msg
+                continue
+            payload = self._postprocess(resp) or {}
+            for k, r in enumerate(rows):
+                out[r] = {key: (v[k] if isinstance(v, list) and k < len(v)
+                                else v)
+                          for key, v in payload.items()}
+        result = table.with_column(self.output_col, out)
+        if self.error_col:
+            result = result.with_column(self.error_col, errs)
+        return result
+
+
 class _TranslatorBase(CognitiveServicesBase):
     _domain = "cognitive.microsofttranslator.com"
     text_col = Param("input text column", default="text")
@@ -133,6 +238,48 @@ class BreakSentence(_TranslatorBase):
 
 
 @register_stage
+class DictionaryLookup(_TranslatorBase):
+    """Alternative translations for a word/phrase (TextTranslator.scala
+    DictionaryLookup)."""
+
+    _path = "/dictionary/lookup"
+    from_language = ServiceParam("source language", default="en")
+    to_language = ServiceParam("target language", default="es")
+
+    def _prepare_url(self, table, i):
+        q = urlencode({"api-version": "3.0",
+                       "from": self.resolve("from_language", table, i),
+                       "to": self.resolve("to_language", table, i)})
+        return f"{self._base_url()}?{q}"
+
+
+@register_stage
+class DictionaryExamples(_TranslatorBase):
+    """Usage examples for a (text, translation) pair (TextTranslator.scala
+    DictionaryExamples); the input column holds (text, translation) pairs."""
+
+    _path = "/dictionary/examples"
+    text_and_translation_col = Param(
+        "column of (text, translation) pairs", default="textAndTranslation")
+    from_language = ServiceParam("source language", default="en")
+    to_language = ServiceParam("target language", default="es")
+
+    def _prepare_url(self, table, i):
+        q = urlencode({"api-version": "3.0",
+                       "from": self.resolve("from_language", table, i),
+                       "to": self.resolve("to_language", table, i)})
+        return f"{self._base_url()}?{q}"
+
+    def _prepare_entity(self, table, i):
+        v = table[self.text_and_translation_col][i]
+        if v is None:
+            return None
+        text, translation = v
+        return json.dumps(
+            [{"Text": str(text), "Translation": str(translation)}]).encode()
+
+
+@register_stage
 class Transliterate(_TranslatorBase):
     _path = "/transliterate"
     language = ServiceParam("source language", default="ja")
@@ -156,6 +303,15 @@ class _FormRecognizerBase(HasImageInput, BasicAsyncReply):
     _url_key = "source"
 
 
+class _HasModelsBase:
+    """Shared custom-models endpoint construction (normalized trailing /)."""
+
+    def _models_base(self) -> str:
+        base = self.url or (f"https://{self.location}.{self._domain}"
+                            "/formrecognizer/v2.1/custom/models")
+        return base.rstrip("/")
+
+
 @register_stage
 class AnalyzeLayout(_FormRecognizerBase):
     _path = "/formrecognizer/v2.1/layout/analyze"
@@ -164,6 +320,81 @@ class AnalyzeLayout(_FormRecognizerBase):
 @register_stage
 class AnalyzeInvoices(_FormRecognizerBase):
     _path = "/formrecognizer/v2.1/prebuilt/invoice/analyze"
+
+
+@register_stage
+class AnalyzeReceipts(_FormRecognizerBase):
+    """FormRecognizer.scala AnalyzeReceipts."""
+
+    _path = "/formrecognizer/v2.1/prebuilt/receipt/analyze"
+
+
+@register_stage
+class AnalyzeBusinessCards(_FormRecognizerBase):
+    """FormRecognizer.scala AnalyzeBusinessCards."""
+
+    _path = "/formrecognizer/v2.1/prebuilt/businessCard/analyze"
+
+
+@register_stage
+class AnalyzeIDDocuments(_FormRecognizerBase):
+    """FormRecognizer.scala AnalyzeIDDocuments."""
+
+    _path = "/formrecognizer/v2.1/prebuilt/idDocument/analyze"
+
+
+@register_stage
+class AnalyzeCustomModel(_HasModelsBase, _FormRecognizerBase):
+    """Analysis against a trained custom model (FormRecognizer.scala
+    AnalyzeCustomModel): the model id routes the request."""
+
+    model_id = ServiceParam("trained custom model id", default=None)
+
+    def _prepare_url(self, table, i):
+        mid = self.resolve("model_id", table, i)
+        if not mid:
+            raise ValueError("AnalyzeCustomModel requires model_id")
+        return f"{self._models_base()}/{mid}/analyze"
+
+
+@register_stage
+class GetCustomModel(_HasModelsBase, CognitiveServicesBase):
+    """Fetch one custom model's metadata (FormRecognizer.scala
+    GetCustomModel): a GET per row, keyed by the model-id value-or-column."""
+
+    model_id = ServiceParam("custom model id", default=None)
+    include_keys = Param("include extracted keys", default=False,
+                         converter=TypeConverters.to_bool)
+
+    def _prepare_method(self):
+        return "GET"
+
+    def _prepare_entity(self, table, i):
+        return b""  # GET: non-None marks the row active
+
+    def _prepare_url(self, table, i):
+        mid = self.resolve("model_id", table, i)
+        if not mid:
+            raise ValueError("GetCustomModel requires model_id")
+        url = f"{self._models_base()}/{mid}"
+        return url + ("?includeKeys=true" if self.include_keys else "")
+
+
+@register_stage
+class ListCustomModels(_HasModelsBase, CognitiveServicesBase):
+    """List the resource's custom models (FormRecognizer.scala
+    ListCustomModels); `op` selects full vs summary listings."""
+
+    op = Param("full|summary", default="full")
+
+    def _prepare_method(self):
+        return "GET"
+
+    def _prepare_entity(self, table, i):
+        return b""
+
+    def _prepare_url(self, table, i):
+        return f"{self._models_base()}?{urlencode({'op': self.op})}"
 
 
 @register_stage

@@ -913,7 +913,11 @@ for _n in ["AnalyzeInvoices", "AnalyzeLayout", "BreakSentence", "Detect",
            "TextSentiment", "AnalyzeImage", "DescribeImage", "DetectFace",
            "FindSimilarFace", "GenerateThumbnails", "GroupFaces",
            "IdentifyFaces", "OCR", "ReadImage",
-           "RecognizeDomainSpecificContent", "TagImage", "VerifyFaces"]:
+           "RecognizeDomainSpecificContent", "TagImage", "VerifyFaces",
+           "AnalyzeReceipts", "AnalyzeBusinessCards", "AnalyzeIDDocuments",
+           "AnalyzeCustomModel", "GetCustomModel", "ListCustomModels",
+           "DictionaryLookup", "DictionaryExamples", "SimpleDetectAnomalies",
+           "SpeechToTextSDK"]:
     _serde_cognitive(_n)
 
 
