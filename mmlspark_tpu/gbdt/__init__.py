@@ -15,9 +15,12 @@ Scala over the SWIG'd C++ engine) redesigned TPU-first:
   - voting-parallel mode reduces collective volume by pre-selecting top-k
     features per shard (params/LightGBMParams.scala:16-21);
   - high-dimensional hashed features train through a sparse CSR dataset
-    path (`CSRMatrix` + ELL histograms with implicit-zero fix-up) — the
-    dense/sparse duality of dataset/DatasetAggregator.scala:69-515.
+    path (`CSRMatrix` + COO histograms with implicit-zero fix-up) — the
+    dense/sparse duality of dataset/DatasetAggregator.scala:69-515;
+  - per-host "single dataset mode" aggregation: concurrent feeders append
+    chunked rows and one elected worker trains (SharedState.scala:16-106).
 """
+from .aggregator import ChunkedArray, DatasetAggregator
 from .binning import BinMapper
 from .boosting import Booster, TrainConfig
 from .sparse import CSRMatrix, SparseBinMapper
@@ -36,6 +39,8 @@ from .tree import Tree
 
 __all__ = [
     "BinMapper",
+    "ChunkedArray",
+    "DatasetAggregator",
     "Booster",
     "CSRMatrix",
     "SparseBinMapper",

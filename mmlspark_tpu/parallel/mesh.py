@@ -39,8 +39,19 @@ def make_mesh(
     model: int = 1,
     seq: int = 1,
     devices: Optional[Sequence] = None,
+    dcn_data: int = 1,
 ) -> Mesh:
-    """Build a (data, model, seq) mesh.  `data=-1` absorbs remaining devices."""
+    """Build a (data, model, seq) mesh.  `data=-1` absorbs remaining devices.
+
+    `dcn_data` > 1 declares a multi-slice layout: the data axis's leading
+    `dcn_data` blocks each live on one slice, so only data-parallel
+    collectives cross DCN while model/seq collectives stay on ICI (the
+    scaling-book slice layout; placement comes from
+    utils.cluster.device_topology rather than raw device order).  When the
+    runtime reports fewer slices than requested (the virtual CPU test
+    mesh), devices are grouped into `dcn_data` contiguous virtual slices so
+    the layout still compiles and is exercised by tests/dryruns.
+    """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data == -1:
@@ -49,7 +60,34 @@ def make_mesh(
         data = n // (model * seq)
     if data * model * seq != n:
         raise ValueError(f"mesh {data}x{model}x{seq} != {n} devices")
-    arr = np.asarray(devices).reshape(data, model, seq)
+    if dcn_data > 1:
+        from ..utils.cluster import device_topology
+
+        if data % dcn_data != 0:
+            raise ValueError(f"data={data} not divisible by dcn_data={dcn_data}")
+        topo = device_topology(devices)
+        if topo.num_slices == dcn_data:
+            groups = topo.slice_groups()
+        elif topo.num_slices <= 1:
+            # single-slice / virtual runtimes (the CPU test mesh): contiguous
+            # equal groups emulate slices so the layout still compiles
+            per = n // dcn_data
+            groups = [list(range(g * per, (g + 1) * per))
+                      for g in range(dcn_data)]
+        else:
+            # a real multi-slice job with a mismatched request must not be
+            # silently laid out across slice boundaries
+            raise ValueError(
+                f"dcn_data={dcn_data} does not match the runtime's "
+                f"{topo.num_slices} slices")
+        if len({len(g) for g in groups}) != 1:
+            raise ValueError("unequal slice sizes cannot form a mesh")
+        # slice-major ordering puts the DCN boundary on the leading blocks
+        # of the data axis
+        ordered = [devices[i] for g in groups for i in g]
+        arr = np.asarray(ordered).reshape(data, model, seq)
+    else:
+        arr = np.asarray(devices).reshape(data, model, seq)
     return Mesh(arr, axis_names=("data", "model", "seq"))
 
 

@@ -354,24 +354,86 @@ class StratifiedRepartition(Transformer):
         return out.with_meta("__partitioning__", {"num_partitions": n})
 
 
-@register_stage
-class PartitionConsolidator(Transformer):
-    """Funnel all data through one elected worker per process to respect
-    per-host rate limits (one HTTP client, one rate-limited resource).
-    Reference: stages/PartitionConsolidator.scala:22-137 — there, 1-of-N Spark
-    partitions per JVM is elected via a shared Consolidator; here the analog
-    is a process-wide single-worker executor through which batches are
-    serialized.
+class _ConsolidationRound:
+    __slots__ = ("parts", "last_arrival")
+
+    def __init__(self, table, now):
+        self.parts = [table]
+        self.last_arrival = now
+
+
+class Consolidator:
+    """Election + funnel shared by concurrent transform callers.
+
+    Reference: stages/PartitionConsolidator.scala:51-137 Consolidator — the
+    first caller opens a round and is 'chosen'; every caller arriving while
+    the round is open deposits its rows INTO the round (atomically, under
+    the round lock) and returns empty; the chosen caller closes the round
+    once no new deposit has arrived for a grace period and emits everything.
+    Because deposit and close both hold the lock, a straggler either lands
+    in the round it observed or opens a fresh round it owns — rows can
+    never be left behind in a shared buffer after the owner has returned.
     """
 
-    concurrency = Param("workers in the shared pool", default=1,
-                        converter=TypeConverters.to_int)
+    def __init__(self, grace_period_s: float = 1.0, poll_s: float = 0.01):
+        import threading
+
+        self.grace_period_s = float(grace_period_s)
+        self.poll_s = float(poll_s)
+        self._lock = threading.Lock()
+        self._round: Optional[_ConsolidationRound] = None
+
+    def register_and_receive(self, table: Table) -> Table:
+        import time
+
+        with self._lock:
+            if self._round is None:
+                self._round = rnd = _ConsolidationRound(table, time.monotonic())
+                chosen = True
+            else:
+                self._round.parts.append(table)
+                self._round.last_arrival = time.monotonic()
+                chosen = False
+        if not chosen:
+            return table.take(np.empty(0, np.int64))
+        # chosen: wait until the round has been quiet for the grace period
+        # (the reference's gracePeriod sleep, PartitionConsolidator.scala:76),
+        # then close it atomically
+        while True:
+            with self._lock:
+                quiet = time.monotonic() - rnd.last_arrival
+                if quiet >= self.grace_period_s:
+                    parts = rnd.parts
+                    self._round = None
+                    break
+            time.sleep(min(self.poll_s, self.grace_period_s))
+        return Table.concat(parts)
+
+
+@register_stage
+class PartitionConsolidator(Transformer):
+    """Funnel all concurrently-transforming data through one elected caller
+    per process so a rate-limited per-host resource (one HTTP client, one
+    metered API) is driven single-file.
+
+    Reference: stages/PartitionConsolidator.scala:22-49 — 1-of-N Spark
+    partitions per JVM is elected via a SharedSingleton Consolidator; here
+    the callers are concurrent transform invocations sharing the
+    process-wide Consolidator keyed by stage uid.
+    """
+
+    grace_period_ms = Param("quiet time before the chosen caller closes its "
+                            "round (every round pays this wait once — the "
+                            "reference's 1s gracePeriod, "
+                            "PartitionConsolidator.scala:76)", default=1000,
+                            converter=TypeConverters.to_int)
 
     def _transform(self, table: Table) -> Table:
-        import concurrent.futures
-
-        pool = shared_singleton(
-            ("PartitionConsolidator", self.concurrency),
-            lambda: concurrent.futures.ThreadPoolExecutor(max_workers=self.concurrency),
+        # key includes the grace so stage.set(grace_period_ms=...) after a
+        # first transform is honored (same rule as get_shared_client)
+        grace = int(self.grace_period_ms)
+        consolidator = shared_singleton(
+            ("PartitionConsolidator", self.uid, grace),
+            lambda: Consolidator(grace_period_s=grace / 1000.0),
         )
-        return pool.submit(lambda: table).result()
+        return consolidator.register_and_receive(table)

@@ -11,6 +11,8 @@ import dataclasses
 import socket
 from typing import List
 
+import numpy as np
+
 
 @dataclasses.dataclass(frozen=True)
 class ClusterInfo:
@@ -48,6 +50,88 @@ def get_num_shards() -> int:
     import jax
 
     return jax.device_count()
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    """One accelerator's place in the job (ClusterUtil.scala's
+    executor/task inference, rebuilt from the jax runtime)."""
+
+    id: int
+    process_index: int
+    slice_index: int
+    coords: tuple  # ICI coordinates; () when the platform has none (CPU)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceTopology:
+    """Hosts-per-slice / devices-per-host map of the running job.
+
+    Reference: ClusterUtil.getNumExecutorTasks/getNumTasksPerExecutor
+    (core/utils/ClusterUtil.scala:20-175) sized the LightGBM/VW rings from
+    SparkConf; here ring sizing IS the mesh, and this is the placement
+    oracle `make_mesh` uses to keep DCN-crossing axes outermost.
+    """
+
+    devices: tuple  # DeviceInfo, in jax.devices() order
+
+    @property
+    def num_slices(self) -> int:
+        return len({d.slice_index for d in self.devices})
+
+    @property
+    def num_hosts(self) -> int:
+        return len({d.process_index for d in self.devices})
+
+    @property
+    def devices_per_host(self) -> int:
+        return len(self.devices) // max(self.num_hosts, 1)
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return self.num_hosts // max(self.num_slices, 1)
+
+    def slice_groups(self) -> "List[List[int]]":
+        """Device ordinals (into the constructing device list) grouped by
+        slice, slice-major — the DCN-outermost ordering."""
+        groups: dict = {}
+        for i, d in enumerate(self.devices):
+            groups.setdefault(d.slice_index, []).append(i)
+        return [groups[s] for s in sorted(groups)]
+
+    def local_ordinals(self, process_index: int) -> "List[int]":
+        """This process's device ordinals (local feed placement)."""
+        return [i for i, d in enumerate(self.devices)
+                if d.process_index == process_index]
+
+
+def device_topology(devices=None) -> DeviceTopology:
+    """Read the topology off the live jax runtime.  Real TPU devices carry
+    slice_index/coords; hosts without them (CPU/virtual meshes) fall back
+    to one slice per process, which keeps the placement math exact on the
+    8-device virtual test mesh."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    infos = []
+    for d in devices:
+        slice_idx = getattr(d, "slice_index", None)
+        if slice_idx is None:
+            slice_idx = d.process_index
+        coords = tuple(getattr(d, "coords", ()) or ())
+        infos.append(DeviceInfo(id=d.id, process_index=d.process_index,
+                                slice_index=int(slice_idx), coords=coords))
+    return DeviceTopology(devices=tuple(infos))
+
+
+def process_mesh_placement(mesh) -> dict:
+    """process_index -> list of mesh index tuples owned by that process —
+    where each host's data feed lands on the mesh."""
+    placement: dict = {}
+    arr = mesh.devices
+    for idx in np.ndindex(arr.shape):
+        placement.setdefault(arr[idx].process_index, []).append(idx)
+    return placement
 
 
 def find_open_port(start: int = 12400, tries: int = 200) -> int:

@@ -240,7 +240,7 @@ def _ex_strat():
 @full("PartitionConsolidator")
 def _ex_consolidator():
     from mmlspark_tpu.stages.basic import PartitionConsolidator
-    return PartitionConsolidator(), _num_table()
+    return PartitionConsolidator(grace_period_ms=50), _num_table()
 
 
 @full("FixedMiniBatchTransformer")

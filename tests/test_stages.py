@@ -180,8 +180,44 @@ class TestPlumbing:
             assert set(labels[parts == p]) == {0, 1}
 
     def test_partition_consolidator(self, small_table):
-        out = PartitionConsolidator().transform(small_table)
+        out = PartitionConsolidator(grace_period_ms=50).transform(small_table)
         assert out.approx_equals(small_table)
+
+    def test_partition_consolidator_funnels_concurrent_callers(self):
+        """Reference semantics (PartitionConsolidator.scala:51-137): with N
+        concurrent transforms, ONE elected caller emits everyone's rows —
+        the rate-limited downstream resource is driven single-file."""
+        import threading
+        import time
+
+        stage = PartitionConsolidator(grace_period_ms=300)
+        n_callers = 4
+        tables = [Table({"x": np.arange(5) + 100 * i}) for i in range(n_callers)]
+        results = [None] * n_callers
+        barrier = threading.Barrier(n_callers)
+
+        def run(i):
+            barrier.wait()
+            time.sleep(0.02 * i)  # staggered arrivals, all inside the grace
+            results[i] = stage.transform(tables[i])
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(n_callers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        non_empty = [r for r in results if len(r)]
+        assert len(non_empty) == 1, [len(r) for r in results]
+        got = sorted(non_empty[0]["x"].tolist())
+        expect = sorted(v for t in tables for v in t["x"].tolist())
+        assert got == expect  # nothing dropped, nothing duplicated
+
+    def test_partition_consolidator_sequential_callers_pass_through(self):
+        stage = PartitionConsolidator(grace_period_ms=20)
+        t1 = Table({"x": np.arange(3)})
+        t2 = Table({"x": np.arange(3) + 10})
+        assert stage.transform(t1)["x"].tolist() == [0, 1, 2]
+        assert stage.transform(t2)["x"].tolist() == [10, 11, 12]
 
 
 class TestText:
