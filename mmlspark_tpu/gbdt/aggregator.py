@@ -79,12 +79,18 @@ class DatasetAggregator:
     """
 
     def __init__(self, num_features: int, expected_feeders: Optional[int] = None,
-                 chunk_rows: int = 4096):
+                 chunk_rows: int = 4096, registration_grace_s: float = 0.5):
         self.num_features = int(num_features)
         self.expected_feeders = expected_feeders
         self.chunk_rows = int(chunk_rows)
+        # without an expected count, build waits for a registration-quiet
+        # window so a straggler that registers after earlier feeders
+        # finished still joins (SharedState sizes the latch from
+        # ClusterUtil's task count; pass expected_feeders for that exactness)
+        self.registration_grace_s = float(registration_grace_s)
         self._lock = threading.Lock()
         self._all_done = threading.Event()
+        self._last_registration = 0.0
         self._feeders: Dict[object, Tuple[ChunkedArray, ChunkedArray, ChunkedArray]] = {}
         self._registration_order: List[object] = []
         self._done: set = set()
@@ -94,7 +100,7 @@ class DatasetAggregator:
     def register(self, feeder_id) -> bool:
         """Join as a feeder; True for the elected (first) one."""
         with self._lock:
-            if self._all_done.is_set():
+            if self._built is not None:
                 raise RuntimeError("aggregator already built")
             if feeder_id in self._feeders:
                 raise ValueError(f"feeder {feeder_id!r} already registered")
@@ -104,6 +110,10 @@ class DatasetAggregator:
                 ChunkedArray(1, chunk_rows=self.chunk_rows),
             )
             self._registration_order.append(feeder_id)
+            import time
+
+            self._last_registration = time.monotonic()
+            self._all_done.clear()  # a new feeder reopens the latch
             if self._elected is None:
                 self._elected = feeder_id
                 return True
@@ -142,11 +152,29 @@ class DatasetAggregator:
         """Elected worker: block until every feeder finished, then merge
         once — natural feeder-id sort order (0..11 numerically, not
         lexicographically), falling back to registration order when ids
-        don't compare.  Returns (x, y, weight)."""
-        if not self._all_done.wait(timeout):
+        don't compare.  Returns (x, y, weight).
+
+        With expected_feeders unset, completion additionally requires a
+        registration-quiet window, so 'first feeder finishes before the
+        second registers' does not build a partial dataset."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.0)
+            if not self._all_done.wait(remaining):
+                with self._lock:
+                    missing = set(self._feeders) - self._done
+                raise TimeoutError(
+                    f"feeders never finished: {sorted(map(repr, missing))}")
+            if self.expected_feeders is not None:
+                break
             with self._lock:
-                missing = set(self._feeders) - self._done
-            raise TimeoutError(f"feeders never finished: {sorted(map(repr, missing))}")
+                quiet = time.monotonic() - self._last_registration
+                if self._all_done.is_set() and quiet >= self.registration_grace_s:
+                    break
+            time.sleep(min(0.01, self.registration_grace_s))
         with self._lock:
             if self._built is None:
                 try:

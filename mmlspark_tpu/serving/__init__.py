@@ -4,6 +4,7 @@ Reference: the Spark Serving L6 subsystem (~1.6k LoC; HTTPSourceV2/
 HTTPSinkV2/DistributedHTTPSource, SURVEY §2.4) — sub-millisecond data path:
 accept, batch, jitted transform, reply over the held socket.
 """
+from .dsl import DistributedServingServer, StreamingQuery, StreamReader, read_stream
 from .registry import ServiceRegistry, list_services, register_service
 from .server import (
     CachedRequest,
@@ -24,4 +25,8 @@ __all__ = [
     "ServiceRegistry",
     "register_service",
     "list_services",
+    "read_stream",
+    "StreamReader",
+    "StreamingQuery",
+    "DistributedServingServer",
 ]

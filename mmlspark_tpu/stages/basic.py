@@ -423,9 +423,12 @@ class PartitionConsolidator(Transformer):
     """
 
     grace_period_ms = Param("quiet time before the chosen caller closes its "
-                            "round (every round pays this wait once — the "
-                            "reference's 1s gracePeriod, "
-                            "PartitionConsolidator.scala:76)", default=1000,
+                            "round; every round (including a lone caller) "
+                            "pays this wait once.  Default 250ms trades the "
+                            "reference's 1s gracePeriod "
+                            "(PartitionConsolidator.scala:76) for per-batch "
+                            "latency; raise it when concurrent callers can "
+                            "arrive far apart", default=250,
                             converter=TypeConverters.to_int)
 
     def _transform(self, table: Table) -> Table:

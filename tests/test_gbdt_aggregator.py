@@ -107,3 +107,28 @@ def test_single_dataset_mode_trains_identically_to_direct_fit():
     ordered = np.concatenate([shards[i] for i in range(k)])
     direct = Booster(TrainConfig(**vars(cfg))).fit(x[ordered], y[ordered])
     np.testing.assert_allclose(booster.score(x), direct.score(x), atol=1e-12)
+
+
+def test_late_registration_not_lost_without_expected_count():
+    """Straggler registering after earlier feeders finished must still be
+    merged (the registration-quiet window guards the latch)."""
+    import time
+
+    agg = DatasetAggregator(num_features=1, registration_grace_s=0.3)
+    agg.register("a")
+    agg.append("a", np.ones((2, 1)), np.ones(2))
+    agg.done("a")  # latch would have fired here pre-fix
+    result = {}
+
+    def elected():
+        result["built"] = agg.wait_and_build(timeout=10)
+
+    t = threading.Thread(target=elected)
+    t.start()
+    time.sleep(0.1)  # inside the quiet window
+    agg.register("b")
+    agg.append("b", np.full((3, 1), 2.0), np.full(3, 2.0))
+    agg.done("b")
+    t.join(timeout=10)
+    _, y, _ = result["built"]
+    assert y.tolist() == [1.0, 1.0, 2.0, 2.0, 2.0]

@@ -277,3 +277,101 @@ def test_serving_latency_qps_regression():
     assert_benchmark(bench, "serving_p50_ms", p50)
     assert_benchmark(bench, "serving_p99_ms", p99)
     assert_benchmark(bench, "serving_qps", qps)
+
+
+# ------------------------------------------------- readStream DSL parity
+
+def test_read_stream_dsl_end_to_end():
+    """IOImplicits.scala:22-199 surface: readStream.continuousServer ->
+    parseRequest -> transform -> makeReply -> start."""
+    from mmlspark_tpu.serving import read_stream
+
+    query = (read_stream()
+             .continuous_server(name="dsl", path="/score")
+             .parse_request(schema=["x"])
+             .transform(lambda t: t.with_column(
+                 "y", np.asarray(t["x"], np.float64) * 5))
+             .make_reply("y")
+             .options(batch_timeout_ms=5.0)
+             .start())
+    try:
+        r = send_request(to_http_request(query.service_info.url, {"x": 6}),
+                         timeout=10)
+        assert r.ok and r.json() == {"y": 30.0}
+        assert query.is_active()
+        assert query.stats["requests"] >= 1
+    finally:
+        query.stop()
+    assert not query.is_active()
+
+
+def test_read_stream_dsl_requires_model_and_reply():
+    from mmlspark_tpu.serving import read_stream
+
+    with pytest.raises(ValueError, match="transform"):
+        read_stream().server().start()
+
+
+def test_read_stream_microbatch_server_mode():
+    from mmlspark_tpu.serving import read_stream
+
+    query = (read_stream()
+             .server(name="micro-dsl", path="/m")
+             .transform(lambda t: t.with_column(
+                 "y", np.asarray(t["x"], np.float64) + 1))
+             .make_reply("y")
+             .options(trigger_interval_ms=10.0)
+             .start())
+    try:
+        assert query._servers[0].mode == "microbatch"
+        r = send_request(to_http_request(query.service_info.url, {"x": 1}),
+                         timeout=10)
+        assert r.ok and r.json() == {"y": 2.0}
+    finally:
+        query.stop()
+
+
+def test_distributed_serving_replicas_and_registry():
+    """DistributedHTTPSource parity: N per-process replicas share the
+    model; every replica is discoverable through the registry and answers
+    on its own socket."""
+    from mmlspark_tpu.io.http.clients import AsyncHTTPClient
+    from mmlspark_tpu.serving import DistributedServingServer, list_services
+
+    dist = DistributedServingServer(
+        model=LambdaTransformer(lambda t: t.with_column(
+            "y", np.asarray(t["x"], np.float64) * 2)),
+        reply_col="y", name="fleet", path="/f", replicas=3,
+        batch_timeout_ms=5.0)
+    infos = dist.start()
+    try:
+        assert len(infos) == 3
+        assert len({i.port for i in infos}) == 3  # distinct sockets
+        found = list_services(dist.registry.url, "fleet")
+        assert len(found) == 3
+        client = AsyncHTTPClient(concurrency=6, timeout=10)
+        # round-robin over the discovered replicas, like the reference's
+        # MultiChannelMap distribution
+        reqs = [to_http_request(infos[i % 3].url, {"x": i}) for i in range(9)]
+        resps = client.send_all(reqs)
+        assert [r.json()["y"] for r in resps] == [2.0 * i for i in range(9)]
+        per_server = [s.stats["requests"] for s in dist.query._servers]
+        assert all(c >= 3 for c in per_server)  # every replica served
+    finally:
+        dist.stop()
+
+
+def test_distributed_server_stop_before_start_is_safe():
+    from mmlspark_tpu.serving import DistributedServingServer
+
+    dist = DistributedServingServer(
+        model=LambdaTransformer(lambda t: t), reply_col="y")
+    dist.stop()  # never started: must return, not deadlock
+    infos = dist.start()
+    try:
+        with pytest.raises(RuntimeError, match="already started"):
+            dist.start()
+    finally:
+        dist.stop()
+    dist.stop()  # idempotent
+    assert infos
