@@ -40,15 +40,38 @@ class ImagePreprocess:
     stable identity for the executor cache.
     """
 
-    def __init__(self, height: int, width: int, mean=None, std=None):
+    def __init__(self, height: int, width: int, mean=None, std=None,
+                 use_pallas: bool = None):
         self.height = int(height)
         self.width = int(width)
         self.mean = tuple(float(m) for m in mean) if mean is not None else None
         self.std = tuple(float(s) for s in std) if std is not None else None
+        # None = auto: the fused Mosaic kernel on TPU, plain XLA elsewhere
+        # (interpret-mode Pallas is far slower than XLA on CPU)
+        self.use_pallas = use_pallas
 
     @property
     def key(self):
-        return ("img", self.height, self.width, self.mean, self.std)
+        return ("img", self.height, self.width, self.mean, self.std,
+                self.use_pallas)
+
+    # one image must stage in VMEM (~16MB/core): input block + its f32 cast
+    # + the resized output; inputs past this budget take the XLA path
+    _PALLAS_VMEM_BUDGET = 8 * 1024 * 1024
+
+    def _pallas_wanted(self, in_shape) -> bool:
+        if self.use_pallas is False:
+            return False
+        if self.use_pallas is None:
+            # auto mode: Mosaic kernels are not GSPMD-partitionable, so the
+            # fused kernel only auto-enables on single-device TPU programs
+            # (multi-chip sharded forwards keep the XLA composition; a
+            # shard_map-wrapped variant can opt in with use_pallas=True)
+            if jax.default_backend() != "tpu" or jax.device_count() != 1:
+                return False
+        h, w, c = in_shape[1], in_shape[2], in_shape[3]
+        staged = h * w * c * (1 + 4) + self.height * self.width * c * 4
+        return staged <= self._PALLAS_VMEM_BUDGET
 
     def __call__(self, batch):
         from ..ops import image as I
@@ -57,6 +80,16 @@ class ImagePreprocess:
             batch = jnp.repeat(batch, 3, axis=-1)
         elif batch.shape[-1] == 4:  # BGRA -> BGR
             batch = batch[..., :3]
+        if self._pallas_wanted(batch.shape):
+            from ..ops.pallas_kernels import fused_resize_normalize
+
+            # cast + bilinear resize + normalize: one VMEM-resident kernel
+            # (SURVEY P2's fused preprocessing; no f32 full-size HBM
+            # intermediate on the uint8 feed path)
+            mean = self.mean or (0.0, 0.0, 0.0)
+            std = self.std or (1.0, 1.0, 1.0)
+            return fused_resize_normalize(batch, self.height, self.width,
+                                          mean, std)
         x = batch.astype(jnp.float32)
         if x.shape[1] != self.height or x.shape[2] != self.width:
             x = I.resize(x, self.height, self.width)
