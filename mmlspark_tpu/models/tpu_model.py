@@ -55,11 +55,12 @@ class ImagePreprocess:
         return ("img", self.height, self.width, self.mean, self.std,
                 self.use_pallas)
 
-    # one image must stage in VMEM (~16MB/core): input block + its f32 cast
-    # + the resized output; inputs past this budget take the XLA path
-    _PALLAS_VMEM_BUDGET = 8 * 1024 * 1024
+    def __setstate__(self, state):
+        # pipelines pickled before use_pallas existed must keep loading
+        self.__dict__.update(state)
+        self.__dict__.setdefault("use_pallas", None)
 
-    def _pallas_wanted(self, in_shape) -> bool:
+    def _pallas_wanted(self) -> bool:
         if self.use_pallas is False:
             return False
         if self.use_pallas is None:
@@ -67,11 +68,8 @@ class ImagePreprocess:
             # fused kernel only auto-enables on single-device TPU programs
             # (multi-chip sharded forwards keep the XLA composition; a
             # shard_map-wrapped variant can opt in with use_pallas=True)
-            if jax.default_backend() != "tpu" or jax.device_count() != 1:
-                return False
-        h, w, c = in_shape[1], in_shape[2], in_shape[3]
-        staged = h * w * c * (1 + 4) + self.height * self.width * c * 4
-        return staged <= self._PALLAS_VMEM_BUDGET
+            return jax.default_backend() == "tpu" and jax.device_count() == 1
+        return True
 
     def __call__(self, batch):
         from ..ops import image as I
@@ -80,14 +78,21 @@ class ImagePreprocess:
             batch = jnp.repeat(batch, 3, axis=-1)
         elif batch.shape[-1] == 4:  # BGRA -> BGR
             batch = batch[..., :3]
-        if self._pallas_wanted(batch.shape):
+        if self._pallas_wanted():
             from ..ops.pallas_kernels import fused_resize_normalize
 
             # cast + bilinear resize + normalize: one VMEM-resident kernel
             # (SURVEY P2's fused preprocessing; no f32 full-size HBM
-            # intermediate on the uint8 feed path)
-            mean = self.mean or (0.0, 0.0, 0.0)
-            std = self.std or (1.0, 1.0, 1.0)
+            # intermediate on the uint8 feed path).  Oversized/identity
+            # inputs fall back to XLA inside the helper.  Normalization
+            # semantics mirror the XLA branch exactly: applied only when
+            # mean is set (std alone is ignored there too).
+            if self.mean is not None:
+                mean = self.mean
+                std = self.std or (1.0,) * len(self.mean)
+            else:
+                mean = (0.0,) * batch.shape[-1]
+                std = (1.0,) * batch.shape[-1]
             return fused_resize_normalize(batch, self.height, self.width,
                                           mean, std)
         x = batch.astype(jnp.float32)

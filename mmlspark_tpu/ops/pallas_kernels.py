@@ -132,6 +132,18 @@ def _resize_weights(n_in: int, n_out: int) -> jnp.ndarray:
     return jax.image.resize(eye, (n_out, n_in), method="linear")
 
 
+# one image must stage in VMEM (~16MB/core): input block + its f32 cast
+# + the resized output; larger inputs take the XLA composition instead of
+# failing the Mosaic compile with a resource error
+PALLAS_IMAGE_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _fits_vmem(in_shape, h_out: int, w_out: int, itemsize: int) -> bool:
+    _, h, w, c = in_shape
+    staged = h * w * c * (itemsize + 4) + h_out * w_out * c * 4
+    return staged <= PALLAS_IMAGE_VMEM_BUDGET
+
+
 def fused_resize_normalize(batch: jnp.ndarray, h_out: int, w_out: int,
                            mean: Sequence[float] = (0.0,),
                            std: Sequence[float] = (1.0,)) -> jnp.ndarray:
@@ -139,15 +151,21 @@ def fused_resize_normalize(batch: jnp.ndarray, h_out: int, w_out: int,
     per-channel normalize in one fused VMEM pass (the ImageTransformer
     resize/normalize tail of SURVEY P2; ImageTransformer.scala:127-146 +
     the normalize feed).  Falls back to the XLA composition when Pallas is
-    unavailable."""
+    unavailable, when the per-image block would overflow VMEM, or when no
+    resize is needed (identity-size inputs are a pure cast+normalize — two
+    identity matmuls would be wasted MXU work)."""
     batch = jnp.asarray(batch)
-    c = batch.shape[-1]
+    _, h_in, w_in, c = batch.shape
     mean = tuple(float(m) for m in np.broadcast_to(np.asarray(mean), (c,)))
     std = tuple(float(s) for s in np.broadcast_to(np.asarray(std), (c,)))
-    if not pallas_available():  # pragma: no cover
+    same_size = h_in == h_out and w_in == w_out
+    if (not pallas_available() or same_size
+            or not _fits_vmem(batch.shape, h_out, w_out, batch.dtype.itemsize)):
         from .image import normalize, resize
 
-        x = resize(batch.astype(jnp.float32), h_out, w_out)
+        x = batch.astype(jnp.float32)
+        if not same_size:
+            x = resize(x, h_out, w_out)
         return normalize(x, mean, std)
     return _fused_resize_normalize_pallas(batch, h_out, w_out, mean, std)
 

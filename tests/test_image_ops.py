@@ -242,12 +242,40 @@ def test_pallas_kernels_compile_on_tpu():
     assert out2.shape == (4, 3 * 32 * 32)
 
 
-def test_image_preprocess_pallas_gates_on_vmem_budget():
+def test_pallas_vmem_gate_and_identity_shortcut():
     """Oversized inputs must fall back to XLA, never attempt a Mosaic
-    compile that would overflow VMEM."""
+    compile that would overflow VMEM; identity-size inputs skip the
+    (pointless) identity matmuls."""
+    from mmlspark_tpu.ops.pallas_kernels import _fits_vmem
+
+    # a 4000x3000 photo: ~36MB uint8 + 144MB f32 cast >> 16MB VMEM
+    assert not _fits_vmem((1, 4000, 3000, 3), 224, 224, 1)
+    assert _fits_vmem((8, 256, 256, 3), 224, 224, 1)
+
+
+def test_image_preprocess_mean_none_std_set_matches_xla():
+    """mean=None disables normalization on BOTH paths — std alone must be
+    ignored identically (a saved pipeline must score the same everywhere)."""
+    import jax.numpy as jnp
+
     from mmlspark_tpu.models.tpu_model import ImagePreprocess
 
-    pre = ImagePreprocess(224, 224, use_pallas=True)
-    # a 4000x3000 photo: ~36MB uint8 + 144MB f32 cast >> 16MB VMEM
-    assert not pre._pallas_wanted((1, 4000, 3000, 3))
-    assert pre._pallas_wanted((8, 256, 256, 3))
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.integers(0, 256, size=(2, 10, 8, 3), dtype=np.uint8))
+    on = ImagePreprocess(6, 6, mean=None, std=[57.0, 57.0, 57.0],
+                         use_pallas=True)(x)
+    off = ImagePreprocess(6, 6, mean=None, std=[57.0, 57.0, 57.0],
+                          use_pallas=False)(x)
+    np.testing.assert_allclose(np.asarray(on), np.asarray(off), atol=1e-4)
+
+
+def test_image_preprocess_unpickles_pre_use_pallas_state():
+    """Pipelines pickled before use_pallas existed must keep loading."""
+    from mmlspark_tpu.models.tpu_model import ImagePreprocess
+
+    old_state = {"height": 8, "width": 8, "mean": None, "std": None}
+    pre = ImagePreprocess.__new__(ImagePreprocess)
+    pre.__setstate__(old_state)
+    assert pre.use_pallas is None
+    assert pre.key[-1] is None
+    assert isinstance(pre._pallas_wanted(), bool)
