@@ -1,0 +1,85 @@
+"""Low-latency model serving with the readStream DSL.
+
+Train a small model, serve it with continuous batching, POST to it, and
+show the distributed multi-replica variant with service discovery
+(the reference's "Spark Serving" quickstart, docs/mmlspark-serving.md).
+
+Run: python examples/03_serving.py
+"""
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even when a site hook pre-registers another backend
+# (same pin as tests/conftest.py); unset, the default backend is used
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.linear import LogisticRegression
+from mmlspark_tpu.serving import DistributedServingServer, list_services, read_stream
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 3)).astype(np.float32)
+    y = (x[:, 0] - x[:, 1] > 0).astype(np.float64)
+    model = LogisticRegression(max_iter=100).fit(
+        Table({"features": x, "label": y}))
+
+    def score(t: Table) -> Table:
+        feats = np.stack([np.asarray(t[c], np.float32)
+                          for c in ("f0", "f1", "f2")], axis=1)
+        out = model.transform(Table({"features": feats}))
+        return t.with_column("prediction", out["prediction"])
+
+    query = (read_stream()
+             .continuous_server(name="scorer", path="/score")
+             .parse_request(schema=["f0", "f1", "f2"])
+             .transform(score)
+             .make_reply("prediction")
+             .start())
+    try:
+        print("serving at", query.service_info.url)
+        print("reply:", post(query.service_info.url,
+                             {"f0": 2.0, "f1": -1.0, "f2": 0.0}))
+    finally:
+        query.stop()
+
+    # distributed: 2 replicas + discovery registry
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+
+    dist = DistributedServingServer(
+        model=LambdaTransformer(score), reply_col="prediction",
+        name="scorer-fleet", path="/score", replicas=2)
+    infos = dist.start()
+    try:
+        print("replicas:", [i.url for i in infos])
+        print("discovered:", len(list_services(dist.registry.url,
+                                               "scorer-fleet")))
+        for i, info in enumerate(infos):
+            print(f"replica {i} ->",
+                  post(info.url, {"f0": -2.0, "f1": 1.0, "f2": 0.0}))
+    finally:
+        dist.stop()
+
+
+if __name__ == "__main__":
+    main()
