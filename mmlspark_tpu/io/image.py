@@ -51,7 +51,17 @@ def image_row_to_array(row: Dict[str, Any]) -> np.ndarray:
 
 
 def decode_image(data: bytes, origin: str = "") -> Dict[str, Any]:
-    """Decode compressed bytes (png/jpeg/bmp/...) to a BGR image row."""
+    """Decode compressed bytes (png/jpeg/bmp/...) to a BGR image row.
+
+    JPEGs take the native libjpeg path (BGR swizzle in the decoder, GIL
+    released — the OpenCV-imdecode analog, SURVEY §2.6/§2.9); everything
+    else, and any native failure, goes through PIL."""
+    if data[:3] == b"\xff\xd8\xff":
+        from .. import native
+
+        arr = native.decode_jpeg_bgr(data)
+        if arr is not None:
+            return array_to_image_row(arr, origin)
     from PIL import Image
 
     img = Image.open(_io.BytesIO(data))

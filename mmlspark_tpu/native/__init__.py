@@ -11,6 +11,8 @@ Surface:
   murmur3_batch(strs, seed)   -> uint32 hashes (VW murmur parity)
   histogram(bins, g, h, node) -> GBDT gradient/hessian histograms
   load_csv_numeric(path)      -> float64 matrix (fast columnar ingestion)
+  decode_jpeg_bgr(bytes)      -> HWC uint8 BGR array (libjpeg fast path,
+                                 DCT-domain 1/2..1/8 scale_denom decodes)
 """
 from __future__ import annotations
 
@@ -23,7 +25,7 @@ from typing import List, Optional, Sequence, Union
 import numpy as np
 
 __all__ = ["available", "build", "murmur3_batch", "histogram",
-           "load_csv_numeric"]
+           "load_csv_numeric", "decode_jpeg_bgr", "jpeg_available"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libmmlspark_native.so")
@@ -31,11 +33,13 @@ _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
+# same default ceiling as PIL's DecompressionBombError threshold
+MAX_JPEG_PIXELS = 178_956_970
+
 
 def build(force: bool = False) -> bool:
-    """Compile the shared lib (make -C mmlspark_tpu/native)."""
-    if os.path.exists(_SO) and not force:
-        return True
+    """Compile the shared lib (make -C mmlspark_tpu/native).  Always runs
+    make (a no-op when fresh) so a stale .so picks up new entry points."""
     try:
         subprocess.run(
             ["make", "-C", _DIR] + (["-B"] if force else []),
@@ -43,7 +47,7 @@ def build(force: bool = False) -> bool:
         )
         return os.path.exists(_SO)
     except (subprocess.SubprocessError, FileNotFoundError):
-        return False
+        return os.path.exists(_SO)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -75,6 +79,19 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.csv_parse.restype = ctypes.c_int64
+        for fn in ("mml_jpeg_probe", "mml_jpeg_decode_bgr"):
+            if hasattr(lib, fn):
+                getattr(lib, fn).restype = ctypes.c_int32
+        if hasattr(lib, "mml_jpeg_probe"):
+            lib.mml_jpeg_probe.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.mml_jpeg_decode_bgr.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
         _LIB = lib
         return _LIB
 
@@ -156,3 +173,55 @@ def load_csv_numeric(path: str, has_header: bool = True) -> np.ndarray:
     if written != r * c:
         raise ValueError(f"CSV parse mismatch: {written} != {r * c}")
     return out.reshape(r, c)
+
+
+def jpeg_available() -> bool:
+    """True when the lib was built against libjpeg (probe returns != -2)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "mml_jpeg_probe"):
+        return False
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    c = ctypes.c_int32()
+    # 2-byte garbage: -1 (bad stream) means jpeg code is compiled in; -2 not
+    buf = np.frombuffer(b"xx", np.uint8)
+    rc = lib.mml_jpeg_probe(buf.ctypes.data, 2, 1, ctypes.byref(h),
+                            ctypes.byref(w), ctypes.byref(c))
+    return rc != -2
+
+
+def decode_jpeg_bgr(data: bytes, scale_denom: int = 1) -> Optional[np.ndarray]:
+    """Decode JPEG bytes to an HWC uint8 array in BGR order (gray: 1
+    channel); None when the native path is unavailable or the stream is
+    invalid.  `scale_denom` in {1,2,4,8} decodes at reduced resolution in
+    the DCT domain — the cheap path when the target size is far below the
+    source (ImageTransformer decode modes, SURVEY §2.6).
+
+    The GIL is released during the C call, so a ThreadPoolExecutor over
+    this function scales decode across host cores.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "mml_jpeg_decode_bgr"):
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    if len(buf) == 0:
+        return None
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    c = ctypes.c_int32()
+    rc = lib.mml_jpeg_probe(buf.ctypes.data, len(buf), int(scale_denom),
+                            ctypes.byref(h), ctypes.byref(w), ctypes.byref(c))
+    if rc != 0:
+        return None
+    # decompression-bomb guard (PIL's Image.MAX_IMAGE_PIXELS analog): the
+    # dims come from an untrusted header; don't allocate gigabytes for them
+    if h.value * w.value > MAX_JPEG_PIXELS:
+        return None
+    out = np.empty(h.value * w.value * c.value, np.uint8)
+    rc = lib.mml_jpeg_decode_bgr(buf.ctypes.data, len(buf), int(scale_denom),
+                                 out.ctypes.data, out.nbytes,
+                                 ctypes.byref(h), ctypes.byref(w),
+                                 ctypes.byref(c))
+    if rc != 0:
+        return None
+    return out.reshape(h.value, w.value, c.value)

@@ -147,4 +147,141 @@ int64_t csv_parse(const char* path, int has_header, double* out,
     return written;
 }
 
+
+// --------------------------------------------------------------- JPEG decode
+// Native JPEG path for the image ingestion hot loop (the reference's OpenCV
+// imdecode, opencv/.../ImageTransformer.scala decode modes) — libjpeg(-turbo)
+// when the build found jpeglib.h, otherwise the entry point reports
+// unavailable (-2) and Python stays on the PIL fallback.  scale_denom gives
+// the 1/2, 1/4, 1/8 DCT-domain decodes for thumbnail-bound pipelines.
+#ifdef MML_HAVE_JPEG
+}  // extern "C"  (jpeglib.h must not be wrapped in extern "C" twice)
+#include <jpeglib.h>
+#include <csetjmp>
+extern "C" {
+
+namespace {
+struct MmlJpegErr {
+    jpeg_error_mgr pub;
+    jmp_buf jb;
+};
+
+void mml_jpeg_error_exit(j_common_ptr cinfo) {
+    longjmp(reinterpret_cast<MmlJpegErr*>(cinfo->err)->jb, 1);
+}
+
+void mml_jpeg_silence(j_common_ptr) {
+    // corrupt rows are a -1 return, not stderr spam (safe_read drops them
+    // silently, matching the PIL path's exception contract)
+}
+
+void mml_jpeg_init_err(jpeg_decompress_struct* cinfo, MmlJpegErr* jerr) {
+    cinfo->err = jpeg_std_error(&jerr->pub);
+    jerr->pub.error_exit = mml_jpeg_error_exit;
+    jerr->pub.output_message = mml_jpeg_silence;
+}
+}  // namespace
+
+// Output dims/channels after scaling; 0 ok, -1 bad stream.
+int32_t mml_jpeg_probe(const uint8_t* data, int64_t len, int32_t scale_denom,
+                       int32_t* h, int32_t* w, int32_t* c) {
+    jpeg_decompress_struct cinfo;
+    MmlJpegErr jerr;
+    mml_jpeg_init_err(&cinfo, &jerr);
+    if (setjmp(jerr.jb)) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(len));
+    if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = scale_denom > 0 ? scale_denom : 1;
+    jpeg_calc_output_dimensions(&cinfo);
+    *h = static_cast<int32_t>(cinfo.output_height);
+    *w = static_cast<int32_t>(cinfo.output_width);
+    *c = cinfo.jpeg_color_space == JCS_GRAYSCALE ? 1 : 3;
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+}
+
+// Decode to HWC uint8, BGR channel order (gray stays 1 channel).
+// 0 ok; -1 bad stream; -3 out buffer too small.
+int32_t mml_jpeg_decode_bgr(const uint8_t* data, int64_t len,
+                            int32_t scale_denom, uint8_t* out,
+                            int64_t out_cap, int32_t* h, int32_t* w,
+                            int32_t* c) {
+    jpeg_decompress_struct cinfo;
+    MmlJpegErr jerr;
+    mml_jpeg_init_err(&cinfo, &jerr);
+    if (setjmp(jerr.jb)) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(len));
+    if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = scale_denom > 0 ? scale_denom : 1;
+    bool gray = cinfo.jpeg_color_space == JCS_GRAYSCALE;
+    bool native_bgr = false;
+    if (gray) {
+        cinfo.out_color_space = JCS_GRAYSCALE;
+    } else {
+#ifdef JCS_EXTENSIONS
+        cinfo.out_color_space = JCS_EXT_BGR;  // libjpeg-turbo: free swizzle
+        native_bgr = true;
+#else
+        cinfo.out_color_space = JCS_RGB;
+#endif
+    }
+    jpeg_start_decompress(&cinfo);
+    const int32_t W = cinfo.output_width, H = cinfo.output_height;
+    const int32_t C = gray ? 1 : 3;
+    if (static_cast<int64_t>(W) * H * C > out_cap) {
+        jpeg_abort_decompress(&cinfo);
+        jpeg_destroy_decompress(&cinfo);
+        return -3;
+    }
+    const int64_t stride = static_cast<int64_t>(W) * C;
+    while (cinfo.output_scanline < cinfo.output_height) {
+        uint8_t* row = out + static_cast<int64_t>(cinfo.output_scanline) * stride;
+        JSAMPROW rows[1] = {row};
+        jpeg_read_scanlines(&cinfo, rows, 1);
+        if (!gray && !native_bgr) {
+            for (int64_t x = 0; x < W; x++) {  // RGB -> BGR in place
+                uint8_t t = row[3 * x];
+                row[3 * x] = row[3 * x + 2];
+                row[3 * x + 2] = t;
+            }
+        }
+    }
+    *h = H;
+    *w = W;
+    *c = C;
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+}
+
+#else  // !MML_HAVE_JPEG
+
+int32_t mml_jpeg_probe(const uint8_t*, int64_t, int32_t, int32_t*, int32_t*,
+                       int32_t*) {
+    return -2;  // built without libjpeg
+}
+
+int32_t mml_jpeg_decode_bgr(const uint8_t*, int64_t, int32_t, uint8_t*,
+                            int64_t, int32_t*, int32_t*, int32_t*) {
+    return -2;
+}
+
+#endif  // MML_HAVE_JPEG
+
 }  // extern "C"

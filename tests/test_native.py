@@ -89,3 +89,101 @@ def test_murmur3_batch_faster_than_python():
     [murmurhash3_32(s.encode(), 0) for s in strings]
     t_py = time.perf_counter() - t0
     assert t_native < t_py, f"native {t_native:.4f}s vs python {t_py:.4f}s"
+
+
+class TestNativeJpeg:
+    def _jpeg(self, arr):
+        import io
+
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=92)
+        return buf.getvalue()
+
+    def test_decode_matches_pil_bgr(self):
+        from mmlspark_tpu import native
+
+        if not native.jpeg_available():
+            pytest.skip("built without libjpeg")
+        import io
+
+        from PIL import Image
+
+        rng = np.random.default_rng(0)
+        # smooth gradient image: JPEG is lossy, but both decoders must
+        # produce the SAME pixels from the same stream (same libjpeg math)
+        base = np.linspace(0, 255, 32 * 24 * 3).reshape(32, 24, 3)
+        arr = (base + rng.normal(0, 8, base.shape)).clip(0, 255).astype(np.uint8)
+        blob = self._jpeg(arr)
+        got = native.decode_jpeg_bgr(blob)
+        pil = np.asarray(Image.open(io.BytesIO(blob)))[:, :, ::-1]
+        assert got.shape == pil.shape
+        # Pillow bundles its own libjpeg build; upsampling defaults can
+        # differ from the system library by +-1 on subsampled images
+        assert np.abs(got.astype(np.int16) - pil.astype(np.int16)).max() <= 1
+
+    def test_decode_gray_single_channel(self):
+        from mmlspark_tpu import native
+
+        if not native.jpeg_available():
+            pytest.skip("built without libjpeg")
+        import io
+
+        from PIL import Image
+
+        arr = np.linspace(0, 255, 16 * 16).reshape(16, 16).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr, mode="L").save(buf, format="JPEG")
+        got = native.decode_jpeg_bgr(buf.getvalue())
+        assert got.shape == (16, 16, 1)
+
+    def test_scale_denom_dct_downscale(self):
+        from mmlspark_tpu import native
+
+        if not native.jpeg_available():
+            pytest.skip("built without libjpeg")
+        rng = np.random.default_rng(1)
+        arr = rng.integers(0, 256, size=(64, 48, 3), dtype=np.uint8)
+        half = native.decode_jpeg_bgr(self._jpeg(arr), scale_denom=2)
+        assert half.shape == (32, 24, 3)
+        eighth = native.decode_jpeg_bgr(self._jpeg(arr), scale_denom=8)
+        assert eighth.shape == (8, 6, 3)
+
+    def test_garbage_returns_none(self):
+        from mmlspark_tpu import native
+
+        assert native.decode_jpeg_bgr(b"\xff\xd8\xffgarbage") is None
+        assert native.decode_jpeg_bgr(b"") is None
+
+    def test_decode_image_routes_jpeg_through_native(self):
+        from mmlspark_tpu import native
+        from mmlspark_tpu.io.image import decode_image, image_row_to_array
+
+        rng = np.random.default_rng(2)
+        arr = rng.integers(0, 256, size=(20, 20, 3), dtype=np.uint8)
+        row = decode_image(self._jpeg(arr))
+        got = image_row_to_array(row)
+        assert got.shape == (20, 20, 3)
+        if native.jpeg_available():
+            # identical to the native path (it IS the native path)
+            np.testing.assert_array_equal(
+                got, native.decode_jpeg_bgr(self._jpeg(arr)))
+
+
+def test_native_jpeg_rejects_decompression_bomb(monkeypatch):
+    from mmlspark_tpu import native
+
+    if not native.jpeg_available():
+        pytest.skip("built without libjpeg")
+    import io
+
+    from PIL import Image
+
+    arr = np.zeros((32, 32, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    blob = buf.getvalue()
+    assert native.decode_jpeg_bgr(blob) is not None
+    monkeypatch.setattr(native, "MAX_JPEG_PIXELS", 100)
+    assert native.decode_jpeg_bgr(blob) is None  # over the cap -> dropped
