@@ -100,6 +100,44 @@ def _synthetic_jpeg_table(n: int):
     return Table({"image": blobs})
 
 
+def _measure_train(batch: int = 256, iters: int = 20) -> dict:
+    """CIFAR10-shape data-parallel training throughput (the second headline
+    config in BASELINE.json: 'CIFAR10 train samples/sec'; reference
+    notebooks/DeepLearning - CIFAR10).  One full train step (fwd + bwd +
+    SGD update) on ResNet-18 at 32x32, jitted, donated state."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from mmlspark_tpu.models.resnet import resnet18
+    from mmlspark_tpu.models.training import init_train_state, make_train_step
+    from mmlspark_tpu.parallel.mesh import MeshContext, batch_sharding, make_mesh
+
+    mesh = make_mesh(data=len(jax.devices()))
+    model = resnet18(num_classes=10, dtype=jnp.bfloat16)
+    opt = optax.sgd(0.1, momentum=0.9)
+    rng = np.random.default_rng(0)
+    with MeshContext(mesh):
+        state = init_train_state(model, opt, (32, 32, 3))
+        step = make_train_step(model, opt, num_classes=10, mesh=mesh,
+                               donate=True)
+        images = jax.device_put(
+            rng.normal(size=(batch, 32, 32, 3)).astype(np.float32),
+            batch_sharding(mesh, 4))
+        labels = jax.device_put(
+            rng.integers(0, 10, size=batch).astype(np.int32),
+            batch_sharding(mesh, 1))
+        state, metrics = step(state, images, labels)   # compile
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step(state, images, labels)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+    return {"train_samples_per_sec": round(iters * batch / dt, 1)}
+
+
 def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     import jax
     import jax.numpy as jnp
@@ -197,6 +235,11 @@ def main():
         return
 
     res = _measure(N_E2E, BATCH, ITERS)
+    try:
+        train = _measure_train()
+    except Exception as e:  # noqa: BLE001 — train bench must not kill the record
+        train = {"train_samples_per_sec": None,
+                 "train_error": str(e)[-200:]}
     record = {
         "metric": "resnet50_imagefeaturizer_images_per_sec_per_chip",
         "value": res["value"],
@@ -204,6 +247,10 @@ def main():
         "vs_baseline": round(res["value"] / baseline, 2) if baseline else 1.0,
         "forward_ips": res["forward_ips"],
         "mfu": res["mfu"],
+        "cifar10_train_samples_per_sec": train.get("train_samples_per_sec"),
+        **({"train_error": train["train_error"]}
+           if train.get("train_samples_per_sec") is None
+           and "train_error" in train else {}),
         "device_kind": res["device_kind"],
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

@@ -171,8 +171,9 @@ void mml_jpeg_error_exit(j_common_ptr cinfo) {
 }
 
 void mml_jpeg_silence(j_common_ptr) {
-    // corrupt rows are a -1 return, not stderr spam (safe_read drops them
-    // silently, matching the PIL path's exception contract)
+    // no stderr spam; corruption is surfaced via err->num_warnings below
+    // (safe_read drops bad rows silently, matching the PIL exception
+    // contract)
 }
 
 void mml_jpeg_init_err(jpeg_decompress_struct* cinfo, MmlJpegErr* jerr) {
@@ -266,8 +267,12 @@ int32_t mml_jpeg_decode_bgr(const uint8_t* data, int64_t len,
     *w = W;
     *c = C;
     jpeg_finish_decompress(&cinfo);
+    // libjpeg treats truncated/corrupt data as a recoverable warning and
+    // pads gray: reject it like PIL does, or garbage rows would silently
+    // enter training data
+    bool corrupt = cinfo.err->num_warnings != 0;
     jpeg_destroy_decompress(&cinfo);
-    return 0;
+    return corrupt ? -1 : 0;
 }
 
 #else  // !MML_HAVE_JPEG

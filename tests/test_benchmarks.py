@@ -105,3 +105,65 @@ def test_assert_benchmark_semantics():
         assert_benchmark(b, "m_hi", 0.80)
     with pytest.raises(AssertionError):
         assert_benchmark(b, "m_lo", 1.2)
+
+
+# ---- VW online-learner AUC regression (the reference's
+# benchmarks_VerifyVowpalWabbitClassifier.csv analog) --------------------
+
+def test_vw_classifier_auc_benchmark():
+    from mmlspark_tpu.online import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+
+    benchmarks = load_benchmarks("benchmarks_vw_classifier.csv")
+    t = _cls_data(seed=11)
+    cols = Table({
+        "f0": np.asarray(t["features"])[:, 0],
+        "f1": np.asarray(t["features"])[:, 1],
+        "f2": np.asarray(t["features"])[:, 2],
+        "f3": np.asarray(t["features"])[:, 3],
+        "label": t["label"],
+    })
+    feat = VowpalWabbitFeaturizer(
+        input_cols=["f0", "f1", "f2", "f3"], num_bits=16)
+    tf = feat.transform(cols)
+    tr, te = tf.slice(0, 300), tf.slice(300)
+    m = VowpalWabbitClassifier(num_passes=10, learning_rate=0.5).fit(tr)
+    scores = np.asarray(m.transform(te)["probability"], np.float64)
+    if scores.ndim == 2:
+        scores = scores[:, -1]
+    auc = roc_auc(np.asarray(te["label"]), scores)
+    assert_benchmark(benchmarks, "auc_vw_binary", auc)
+
+
+# ---- SAR recommendation NDCG regression --------------------------------
+
+def test_sar_ndcg_benchmark():
+    from mmlspark_tpu.recommendation import (
+        RankingAdapter,
+        RankingEvaluator,
+        SAR,
+    )
+
+    from mmlspark_tpu.recommendation.tvs import per_user_split
+
+    benchmarks = load_benchmarks("benchmarks_recommendation.csv")
+    rng = np.random.default_rng(21)
+    rows_u, rows_i, rows_r = [], [], []
+    for u in range(40):
+        group = u % 3
+        for i in range(group * 4, group * 4 + 4):  # the group's taste
+            rows_u.append(u)
+            rows_i.append(i)
+            rows_r.append(5.0)
+        rows_u.append(u)                            # one cross-group item
+        rows_i.append(int(rng.integers(0, 12)))
+        rows_r.append(float(rng.integers(1, 4)))
+    t = Table({"user": np.asarray(rows_u, np.int64),
+               "item": np.asarray(rows_i, np.int64),
+               "rating": np.asarray(rows_r)})
+    # recommendations exclude seen items, so NDCG must score held-out
+    # interactions (the RankingTrainValidationSplit methodology)
+    train, valid = per_user_split(t, "user", 0.6, seed=2)
+    model = RankingAdapter(recommender=SAR(support_threshold=1), k=5).fit(train)
+    ndcg = RankingEvaluator(metric_name="ndcgAt", k=5).evaluate(
+        model.transform(valid))
+    assert_benchmark(benchmarks, "ndcg_at_5_sar", float(ndcg))

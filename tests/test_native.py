@@ -187,3 +187,25 @@ def test_native_jpeg_rejects_decompression_bomb(monkeypatch):
     assert native.decode_jpeg_bgr(blob) is not None
     monkeypatch.setattr(native, "MAX_JPEG_PIXELS", 100)
     assert native.decode_jpeg_bgr(blob) is None  # over the cap -> dropped
+
+
+def test_native_jpeg_rejects_truncated_stream():
+    """libjpeg pads truncated data with gray as a 'warning'; the native
+    path must reject it like PIL does, not emit garbage rows."""
+    import io
+
+    from PIL import Image
+
+    from mmlspark_tpu import native
+
+    if not native.jpeg_available():
+        pytest.skip("built without libjpeg")
+    arr = np.random.default_rng(3).integers(0, 256, (64, 64, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+    blob = buf.getvalue()
+    truncated = blob[: len(blob) // 2]
+    assert native.decode_jpeg_bgr(truncated) is None
+    from mmlspark_tpu.io.image import safe_read
+
+    assert safe_read(truncated) is None
