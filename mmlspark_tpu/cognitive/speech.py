@@ -23,7 +23,8 @@ from ..core.schema import Table
 from ..io.http.schema import HTTPRequestData
 from .base import CognitiveServicesBase
 
-__all__ = ["WavStream", "CompressedStream", "SpeechToTextSDK"]
+__all__ = ["WavStream", "CompressedStream", "SpeechToTextSDK",
+           "ConversationTranscription"]
 
 
 class WavStream:
@@ -115,9 +116,11 @@ class SpeechToTextSDK(CognitiveServicesBase):
                             converter=TypeConverters.to_bool)
 
     def _recognize_url(self, table, i) -> str:
+        base = self._base_url()
+        sep = "&" if "?" in base else "?"  # user urls may carry a query
         q = urlencode({"language": self.resolve("language", table, i),
                        "format": self.format})
-        return f"{self._base_url()}?{q}"
+        return f"{base}{sep}{q}"
 
     def _windows(self, audio: bytes):
         if self.stream_format == "wav":
@@ -201,3 +204,16 @@ class SpeechToTextSDK(CognitiveServicesBase):
         return list(columns) + [self.output_col] + (
             [self.error_col] if self.error_col and not self.flatten_results
             else [])
+
+
+@register_stage
+class ConversationTranscription(SpeechToTextSDK):
+    """Multi-speaker conversation transcription: the same windowed audio
+    streaming as SpeechToTextSDK against the conversation-transcription
+    endpoint, with the service's speaker attribution passed through on
+    every utterance (reference SpeechToTextSDK.scala ConversationTranscription
+    variant — there a different SDK recognizer class, same emitted schema
+    plus speakerId)."""
+
+    _path = ("/speech/recognition/conversation/cognitiveservices/v1"
+             "?transcriptionMode=conversation")

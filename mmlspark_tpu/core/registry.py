@@ -20,6 +20,7 @@ STAGE_MODULES = [
     "mmlspark_tpu.ops.image_stages",
     "mmlspark_tpu.models.tpu_model",
     "mmlspark_tpu.models.image_featurizer",
+    "mmlspark_tpu.models.deep_vision",
     "mmlspark_tpu.models.bilstm",
     "mmlspark_tpu.featurize.featurize",
     "mmlspark_tpu.featurize.value_indexer",

@@ -157,6 +157,7 @@ int64_t csv_parse(const char* path, int has_header, double* out,
 #ifdef MML_HAVE_JPEG
 }  // extern "C"  (jpeglib.h must not be wrapped in extern "C" twice)
 #include <jpeglib.h>
+#include <jerror.h>
 #include <csetjmp>
 extern "C" {
 
@@ -164,22 +165,30 @@ namespace {
 struct MmlJpegErr {
     jpeg_error_mgr pub;
     jmp_buf jb;
+    bool truncated;
 };
 
 void mml_jpeg_error_exit(j_common_ptr cinfo) {
     longjmp(reinterpret_cast<MmlJpegErr*>(cinfo->err)->jb, 1);
 }
 
-void mml_jpeg_silence(j_common_ptr) {
-    // no stderr spam; corruption is surfaced via err->num_warnings below
-    // (safe_read drops bad rows silently, matching the PIL exception
-    // contract)
+void mml_jpeg_emit(j_common_ptr cinfo, int msg_level) {
+    // no stderr spam; PIL-parity: only truncation (premature EOF) rejects
+    // the image — benign warnings (extraneous marker bytes etc.) decode
+    // fine everywhere and must not force a PIL re-decode
+    if (msg_level == -1 && cinfo->err->msg_code == JWRN_JPEG_EOF) {
+        reinterpret_cast<MmlJpegErr*>(cinfo->err)->truncated = true;
+    }
 }
+
+void mml_jpeg_silence(j_common_ptr) {}
 
 void mml_jpeg_init_err(jpeg_decompress_struct* cinfo, MmlJpegErr* jerr) {
     cinfo->err = jpeg_std_error(&jerr->pub);
     jerr->pub.error_exit = mml_jpeg_error_exit;
+    jerr->pub.emit_message = mml_jpeg_emit;
     jerr->pub.output_message = mml_jpeg_silence;
+    jerr->truncated = false;
 }
 }  // namespace
 
@@ -267,10 +276,10 @@ int32_t mml_jpeg_decode_bgr(const uint8_t* data, int64_t len,
     *w = W;
     *c = C;
     jpeg_finish_decompress(&cinfo);
-    // libjpeg treats truncated/corrupt data as a recoverable warning and
-    // pads gray: reject it like PIL does, or garbage rows would silently
-    // enter training data
-    bool corrupt = cinfo.err->num_warnings != 0;
+    // libjpeg treats truncated data as a recoverable warning and pads
+    // gray: reject it like PIL does, or garbage rows would silently enter
+    // training data (benign warnings still decode)
+    bool corrupt = jerr.truncated;
     jpeg_destroy_decompress(&cinfo);
     return corrupt ? -1 : 0;
 }

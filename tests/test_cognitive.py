@@ -507,3 +507,27 @@ def test_custom_models_url_trailing_slash_normalized(mock_url):
     out = ListCustomModels(
         url=f"{mock_url}/formrecognizer/v2.1/custom/models/").transform(t)
     assert out["output"][0]["summary"]["count"] == 2
+
+
+def test_conversation_transcription_query_joining(mock_url):
+    """The conversation endpoint carries a query string; language/format
+    params must join with '&' (a second '?' would break the service URL)."""
+    from mmlspark_tpu.cognitive import ConversationTranscription
+
+    audio = np.empty(1, dtype=object)
+    audio[0] = _make_wav(0.5)
+    t = Table({"audio": audio})
+    before = len(_MockService.log)
+    out = ConversationTranscription(
+        url=(f"{mock_url}/speech/recognition/conversation/cognitiveservices"
+             "/v1?transcriptionMode=conversation"),
+        window_ms=250).transform(t)
+    segs = out["output"][0]
+    assert len(segs) == 2
+    assert [s["StreamOffsetMs"] for s in segs] == [0.0, 250.0]
+    reqs = [e for e in _MockService.log[before:] if "speech" in e["path"]]
+    assert reqs, "no recognition requests hit the mock"
+    for e in reqs:
+        assert e["path"].count("?") == 1
+        assert "transcriptionMode=conversation" in e["path"]
+        assert "&language=" in e["path"]

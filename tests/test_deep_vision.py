@@ -1,0 +1,68 @@
+"""DeepVisionClassifier: end-to-end backbone fine-tuning on the mesh."""
+import io
+
+import numpy as np
+import pytest
+
+from PIL import Image
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.deep_vision import DeepVisionClassifier, DeepVisionModel
+
+from fuzzing import fuzz_estimator
+
+
+def _color_dataset(n=32, seed=0, as_jpeg=False, ragged=False):
+    rng = np.random.default_rng(seed)
+    rows = np.empty(n, object)
+    labels = []
+    for i in range(n):
+        label = i % 2
+        base = np.array([30, 30, 200] if label else [200, 30, 30], np.uint8)
+        hw = (40, 36) if (ragged and i % 3 == 0) else (32, 32)
+        arr = np.clip(rng.normal(base, 25, (*hw, 3)), 0, 255).astype(np.uint8)
+        if as_jpeg:
+            buf = io.BytesIO()
+            Image.fromarray(arr[:, :, ::-1]).save(buf, format="JPEG")
+            rows[i] = buf.getvalue()
+        else:
+            rows[i] = arr
+        labels.append("pos" if label else "neg")
+    return Table({"image": rows, "label": np.asarray(labels, object)})
+
+
+def test_finetune_learns_and_scores():
+    t = _color_dataset(48)
+    model = DeepVisionClassifier(backbone="resnet18", epochs=3, batch_size=16,
+                                 learning_rate=0.05, seed=0).fit(t)
+    assert model.loss_history[0] > model.loss_history[-1] or \
+        model.loss_history[-1] < 0.05
+    out = model.transform(t)
+    acc = (out["prediction"] == t["label"]).mean()
+    assert acc > 0.9
+    probs = np.asarray(out["probability"])
+    assert probs.shape == (48, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_jpeg_bytes_and_ragged_inputs():
+    t = _color_dataset(24, as_jpeg=True, ragged=True)
+    model = DeepVisionClassifier(backbone="resnet18", epochs=2, batch_size=8,
+                                 seed=1).fit(t)
+    out = model.transform(t)
+    assert len(out) == 24
+    assert set(np.unique(out["prediction"])) <= {"pos", "neg"}
+
+
+def test_string_labels_round_trip_through_classes():
+    t = _color_dataset(16)
+    model = DeepVisionClassifier(backbone="resnet18", epochs=1,
+                                 batch_size=8).fit(t)
+    assert sorted(model.classes) == ["neg", "pos"]
+    assert isinstance(model, DeepVisionModel)
+
+
+def test_fuzz_roundtrip():
+    t = _color_dataset(12)
+    fuzz_estimator(DeepVisionClassifier(backbone="resnet18", epochs=1,
+                                        batch_size=8, seed=3), t, rtol=1e-3)

@@ -337,6 +337,20 @@ def _ex_tpu_model():
                     batch_size=4), t
 
 
+@full("DeepVisionClassifier")
+def _ex_deep_vision():
+    from mmlspark_tpu.models.deep_vision import DeepVisionClassifier
+    rows = np.empty(8, object)
+    labels = []
+    for i in range(8):
+        base = np.array([30, 30, 200] if i % 2 else [200, 30, 30], np.uint8)
+        rows[i] = np.clip(_RNG.normal(base, 20, (32, 32, 3)), 0, 255).astype(np.uint8)
+        labels.append(float(i % 2))
+    t = Table({"image": rows, "label": np.asarray(labels)})
+    return DeepVisionClassifier(backbone="resnet18", epochs=1, batch_size=8,
+                                seed=0), t
+
+
 @full("ImageFeaturizer")
 def _ex_image_featurizer():
     from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
@@ -917,7 +931,7 @@ for _n in ["AnalyzeInvoices", "AnalyzeLayout", "BreakSentence", "Detect",
            "AnalyzeReceipts", "AnalyzeBusinessCards", "AnalyzeIDDocuments",
            "AnalyzeCustomModel", "GetCustomModel", "ListCustomModels",
            "DictionaryLookup", "DictionaryExamples", "SimpleDetectAnomalies",
-           "SpeechToTextSDK"]:
+           "SpeechToTextSDK", "ConversationTranscription"]:
     _serde_cognitive(_n)
 
 
@@ -963,6 +977,7 @@ VIA_ESTIMATOR = {
     "GBDTRankerModel": "GBDTRanker",
     "IsolationForestModel": "IsolationForest",
     "SequenceTaggerModel": "SequenceTagger",
+    "DeepVisionModel": "DeepVisionClassifier",
     "LinearRegressionModel": "LinearRegression",
     "LogisticRegressionModel": "LogisticRegression",
     "TrainedClassifierModel": "TrainClassifier",
