@@ -14,7 +14,6 @@ SURVEY §2.10 data-parallel mapping.
 """
 from __future__ import annotations
 
-import os
 from typing import List, Optional
 
 import numpy as np
@@ -24,7 +23,7 @@ from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
 from ..core.schema import Table, find_unused_column_name
 from ..io.image import image_row_to_array
-from ..ops.image_stages import _decode_cell
+from ..ops.image_stages import decode_cells
 from .bundle import FlaxBundle
 from .image_featurizer import IMAGENET_MEAN_BGR, IMAGENET_STD_BGR
 from .tpu_model import ImagePreprocess, TPUModel
@@ -35,13 +34,7 @@ __all__ = ["DeepVisionClassifier", "DeepVisionModel"]
 def _decode_column(col: np.ndarray) -> List[Optional[np.ndarray]]:
     """Image rows / encoded bytes / arrays -> HWC uint8 arrays (None for
     undecodable rows) — the ImageFeaturizer host contract."""
-    if len(col) > 32:
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=min(16, os.cpu_count() or 4)) as ex:
-            cells = list(ex.map(_decode_cell, col))
-    else:
-        cells = [_decode_cell(v) for v in col]
+    cells = decode_cells(col)
     return [None if c is None else image_row_to_array(c) for c in cells]
 
 
@@ -72,6 +65,9 @@ class DeepVisionClassifier(Estimator):
     seed = Param("shuffle/init seed", default=0, converter=TypeConverters.to_int)
     drop_na = Param("drop undecodable rows", default=True,
                     converter=TypeConverters.to_bool)
+    checkpoint_dir = Param("orbax checkpoint directory: saves per epoch and "
+                           "resumes an interrupted fit from the latest step "
+                           "(SURVEY §5 checkpoint/resume)", default="")
 
     def _fit(self, table: Table) -> "DeepVisionModel":
         import jax
@@ -104,13 +100,22 @@ class DeepVisionClassifier(Estimator):
         from PIL import Image
 
         def to_hw(a: np.ndarray) -> np.ndarray:
-            if a.shape[0] == h and a.shape[1] == w and a.shape[2] == 3:
-                return a
+            # channel-normalize BEFORE stacking: gray -> 3, BGRA -> BGR
+            # (the scoring path does the same on device in ImagePreprocess)
+            if a.ndim == 2:
+                a = a[:, :, None]
             if a.shape[2] == 1:
                 a = np.repeat(a, 3, axis=2)
+            elif a.shape[2] > 3:
+                a = a[:, :, :3]
+            if a.shape[0] == h and a.shape[1] == w:
+                return a
             img = Image.fromarray(a[:, :, ::-1])  # BGR->RGB for PIL
             return np.asarray(img.resize((w, h)))[:, :, ::-1]
 
+        if not keep:
+            raise ValueError("DeepVisionClassifier: no decodable training "
+                             "rows in the input table")
         x = np.stack([to_hw(arrays[i]) for i in keep]).astype(np.uint8)
 
         builder = getattr(resnet_mod, self.backbone)
@@ -148,6 +153,20 @@ class DeepVisionClassifier(Estimator):
         rng = np.random.default_rng(int(self.seed))
         with MeshContext(mesh):
             state = init_train_state(model, opt, (h, w, 3), seed=int(self.seed))
+            ckpt = None
+            start_epoch = 0
+            if self.checkpoint_dir:
+                from .checkpoint import CheckpointManager
+
+                ckpt = CheckpointManager(self.checkpoint_dir)
+                latest = ckpt.latest_step()
+                if latest is not None:
+                    # the manager's step IS the completed-epoch count, so a
+                    # resume never depends on this run's batch math; a dir
+                    # checkpointed at >= epochs yields zero further epochs
+                    # (clear it to retrain from scratch)
+                    state = ckpt.restore(latest, template=state)
+                    start_epoch = min(int(latest), int(self.epochs))
             step = jax.jit(step_fn,
                            in_shardings=(None, batch_sharding(mesh, 4),
                                          batch_sharding(mesh, 1)),
@@ -155,7 +174,11 @@ class DeepVisionClassifier(Estimator):
             img_sh = batch_sharding(mesh, 4)
             lbl_sh = batch_sharding(mesh, 1)
             history = []
-            for _epoch in range(int(self.epochs)):
+            # the shuffle stream must be reproducible across a resume:
+            # replay the epochs already consumed
+            for _ in range(start_epoch):
+                rng.permutation(len(x))
+            for _epoch in range(start_epoch, int(self.epochs)):
                 order = rng.permutation(len(x))
                 losses = []
                 for start in range(0, len(order), bs):
@@ -175,6 +198,12 @@ class DeepVisionClassifier(Estimator):
                                        jax.device_put(yb, lbl_sh))
                     losses.append(loss)
                 history.append(float(np.mean([np.asarray(l) for l in losses])))
+                if ckpt is not None:
+                    host_state = jax.tree.map(
+                        lambda a: np.asarray(a), state)
+                    ckpt.save(host_state, step=_epoch + 1)
+            if ckpt is not None:
+                ckpt.close()
 
             params_host = jax.tree.map(
                 lambda a: np.asarray(a, np.float32), state.params)
@@ -235,6 +264,13 @@ class DeepVisionModel(Model):
             fetch_node="logits", batch_size=64, preprocess=pre,
             group_by_shape=True, feed_dtype="uint8",
         ).transform(feed).drop(tmp)
+        if len(scored) == 0:
+            n_cls = len(self.classes)
+            out = scored.drop(logits_col)
+            out = out.with_column(self.probability_col,
+                                  np.zeros((0, n_cls), np.float64))
+            return out.with_column(self.prediction_col,
+                                   np.empty(0, dtype=np.asarray(self.classes).dtype))
         logits = np.stack(list(scored[logits_col]))
         probs = np.exp(logits - logits.max(axis=1, keepdims=True))
         probs /= probs.sum(axis=1, keepdims=True)

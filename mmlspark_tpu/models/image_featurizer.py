@@ -9,7 +9,6 @@ jitted XLA program per shape group via ImageTransformer + TPUModel.
 """
 from __future__ import annotations
 
-import os
 from typing import Any, List, Optional
 
 import numpy as np
@@ -19,7 +18,7 @@ from ..core.pipeline import Transformer
 from ..core.registry import register_stage
 from ..core.schema import Table, find_unused_column_name
 from ..io.image import image_row_to_array
-from ..ops.image_stages import _decode_cell
+from ..ops.image_stages import decode_cells
 from .bundle import ModelBundle
 from .tpu_model import ImagePreprocess, TPUModel
 
@@ -70,16 +69,7 @@ class ImageFeaturizer(Transformer):
         # XLA program per input-shape group (ImagePreprocess), fed as uint8
         # with an async double-buffered device feed (TPUModel._run_chunks).
         col = table[self.input_col]
-        if len(col) > 32:
-            # PIL's codecs release the GIL: thread-parallel decode keeps the
-            # host from starving the chip (the reference decodes per-row on
-            # JVM task threads, ImageUtils.scala:26)
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=min(16, os.cpu_count() or 4)) as ex:
-                cells = list(ex.map(_decode_cell, col))
-        else:
-            cells = [_decode_cell(v) for v in col]
+        cells = decode_cells(col)
         keep = np.array([c is not None for c in cells])
         if self.drop_na:
             table = table.filter(keep)

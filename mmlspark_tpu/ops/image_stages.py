@@ -59,6 +59,22 @@ def _decode_cell(v: Any) -> Optional[Dict[str, Any]]:
     return None
 
 
+def decode_cells(col: np.ndarray) -> list:
+    """Decode a whole image column (rows/bytes/arrays -> image rows, None
+    for undecodable cells).  PIL's and the native decoder's codecs release
+    the GIL, so larger columns decode thread-parallel — the shared host
+    decode policy of ImageFeaturizer/DeepVisionClassifier (the reference
+    decodes per-row on JVM task threads, ImageUtils.scala:26)."""
+    import os
+
+    if len(col) > 32:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(16, os.cpu_count() or 4)) as ex:
+            return list(ex.map(_decode_cell, col))
+    return [_decode_cell(v) for v in col]
+
+
 class _BatchedImageStage(Transformer):
     """Shared machinery: gather image rows -> same-shape float32 batches ->
     jitted op pipeline -> scatter back."""

@@ -66,3 +66,60 @@ def test_fuzz_roundtrip():
     t = _color_dataset(12)
     fuzz_estimator(DeepVisionClassifier(backbone="resnet18", epochs=1,
                                         batch_size=8, seed=3), t, rtol=1e-3)
+
+
+def test_checkpoint_resume_continues_training(tmp_path):
+    """Interrupt after 1 of 3 epochs; a new fit with the same checkpoint
+    dir resumes (not restarts) and matches an uninterrupted 3-epoch fit."""
+    t = _color_dataset(24, seed=7)
+    ck = str(tmp_path / "ck")
+    common = dict(backbone="resnet18", batch_size=8, learning_rate=0.05,
+                  seed=9, checkpoint_dir=ck)
+
+    DeepVisionClassifier(epochs=1, **common).fit(t)     # "interrupted" run
+    resumed = DeepVisionClassifier(epochs=3, **common).fit(t)
+    # resumed run trained only the remaining 2 epochs
+    assert len(resumed.loss_history) == 2
+
+    full = DeepVisionClassifier(
+        epochs=3, backbone="resnet18", batch_size=8, learning_rate=0.05,
+        seed=9).fit(t)
+    out_r = resumed.transform(t)
+    out_f = full.transform(t)
+    np.testing.assert_allclose(
+        np.asarray(out_r["probability"], np.float64),
+        np.asarray(out_f["probability"], np.float64), atol=5e-2)
+    assert (out_r["prediction"] == out_f["prediction"]).mean() >= 0.9
+
+
+def test_fit_all_undecodable_raises_clearly():
+    bad = np.empty(3, object)
+    for i in range(3):
+        bad[i] = b"not an image"
+    t = Table({"image": bad, "label": np.asarray([0.0, 1.0, 0.0])})
+    with pytest.raises(ValueError, match="no decodable"):
+        DeepVisionClassifier(epochs=1).fit(t)
+
+
+def test_transform_empty_and_mixed_channels():
+    t = _color_dataset(12, seed=4)
+    model = DeepVisionClassifier(backbone="resnet18", epochs=1,
+                                 batch_size=8).fit(t)
+    # empty transform: columns present, zero rows, no crash
+    empty = Table({"image": np.empty(0, object)})
+    out = model.transform(empty)
+    assert len(out) == 0
+    assert out["probability"].shape == (0, 2)
+    # mixed gray/BGRA inputs train without shape crashes
+    rng = np.random.default_rng(6)
+    rows = np.empty(8, object)
+    for i in range(8):
+        if i % 3 == 0:
+            rows[i] = rng.integers(0, 256, (32, 32), np.uint8)       # gray 2-D
+        elif i % 3 == 1:
+            rows[i] = rng.integers(0, 256, (32, 32, 4), np.uint8)    # BGRA
+        else:
+            rows[i] = rng.integers(0, 256, (32, 32, 3), np.uint8)
+    mixed = Table({"image": rows, "label": np.asarray([float(i % 2) for i in range(8)])})
+    m2 = DeepVisionClassifier(backbone="resnet18", epochs=1, batch_size=8).fit(mixed)
+    assert len(m2.transform(mixed)) == 8
