@@ -40,14 +40,17 @@ class CheckpointManager:
              wait: bool = True) -> int:
         import orbax.checkpoint as ocp
 
-        step = int(state.step if step is None else step)
+        # the manager's numbering (`step` arg, e.g. an epoch count) is
+        # independent of the state's per-batch counter, which must survive
+        # the round trip for anything keyed off TrainState.step
+        mgr_step = int(state.step if step is None else step)
         payload = {
             "params": state.params,
             "batch_stats": state.batch_stats,
             "opt_state": state.opt_state,
-            "step": np.asarray(step),
+            "step": np.asarray(int(state.step)),
         }
-        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        self._mgr.save(mgr_step, args=ocp.args.StandardSave(payload))
         if wait:
             self._mgr.wait_until_finished()
         return step

@@ -199,9 +199,12 @@ class DeepVisionClassifier(Estimator):
                     losses.append(loss)
                 history.append(float(np.mean([np.asarray(l) for l in losses])))
                 if ckpt is not None:
+                    # the host copy decouples the buffers from the donated
+                    # jit state, so the orbax write can proceed async; the
+                    # close() below waits for pending saves
                     host_state = jax.tree.map(
                         lambda a: np.asarray(a), state)
-                    ckpt.save(host_state, step=_epoch + 1)
+                    ckpt.save(host_state, step=_epoch + 1, wait=False)
             if ckpt is not None:
                 ckpt.close()
 
@@ -268,10 +271,10 @@ class DeepVisionModel(Model):
             n_cls = len(self.classes)
             out = scored.drop(logits_col)
             out = out.with_column(self.probability_col,
-                                  np.zeros((0, n_cls), np.float64))
+                                  np.zeros((0, n_cls), np.float32))
             return out.with_column(self.prediction_col,
                                    np.empty(0, dtype=np.asarray(self.classes).dtype))
-        logits = np.stack(list(scored[logits_col]))
+        logits = np.stack(list(scored[logits_col])).astype(np.float32)
         probs = np.exp(logits - logits.max(axis=1, keepdims=True))
         probs /= probs.sum(axis=1, keepdims=True)
         classes = np.asarray(self.classes)

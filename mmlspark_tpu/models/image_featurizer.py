@@ -43,6 +43,9 @@ class ImageFeaturizer(Transformer):
     batch_size = Param("device minibatch size", default=64, converter=TypeConverters.to_int)
     normalize = Param("apply ImageNet mean/std normalization", default=True,
                       converter=TypeConverters.to_bool)
+    use_pallas = Param("fused Mosaic preprocessing kernel: None = auto "
+                       "(single-device TPU only), False = always XLA",
+                       default=None)
 
     def __init__(self, bundle: Optional[ModelBundle] = None, **kw):
         super().__init__(**kw)
@@ -87,6 +90,7 @@ class ImageFeaturizer(Transformer):
             h, w,
             mean=IMAGENET_MEAN_BGR if self.normalize else None,
             std=IMAGENET_STD_BGR if self.normalize else None,
+            use_pallas=self.get_or_default("use_pallas"),
         )
         model = TPUModel(
             bundle=bundle,
