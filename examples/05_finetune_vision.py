@@ -17,12 +17,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # honor JAX_PLATFORMS even when a site hook pre-registers another backend
 # (same pin as tests/conftest.py); unset, the default backend is used
-import os as _os
-
-if _os.environ.get("JAX_PLATFORMS"):
+if os.environ.get("JAX_PLATFORMS"):
     import jax as _jax
 
-    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import numpy as np
 

@@ -53,7 +53,7 @@ class CheckpointManager:
         self._mgr.save(mgr_step, args=ocp.args.StandardSave(payload))
         if wait:
             self._mgr.wait_until_finished()
-        return step
+        return mgr_step
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

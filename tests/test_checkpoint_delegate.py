@@ -175,3 +175,28 @@ def test_estimator_with_lambda_delegate_saves(gbdt_table, tmp_path):
     loaded = PipelineStage.load(p)
     assert loaded.get_or_default("delegate") is None  # transient: not restored
     loaded.fit(gbdt_table)  # still trains fine without the delegate
+
+
+def test_save_returns_manager_step(tmp_path):
+    """save() must return the step it saved under (the manager numbering),
+    including through the save_checkpoint convenience wrapper."""
+    import optax
+
+    from mmlspark_tpu.models.checkpoint import CheckpointManager, save_checkpoint
+    from mmlspark_tpu.models.resnet import resnet18
+    from mmlspark_tpu.models.training import init_train_state
+
+    import jax.numpy as jnp
+
+    model = resnet18(num_classes=4, dtype=jnp.float32)
+    opt = optax.sgd(0.1)
+    state = init_train_state(model, opt, (8, 8, 3))
+    state.step = 7
+    mgr = CheckpointManager(str(tmp_path / "a"))
+    try:
+        assert mgr.save(state, step=3) == 3       # explicit manager step
+        restored = mgr.restore(3, template=state)
+        assert restored.step == 7                  # state counter preserved
+    finally:
+        mgr.close()
+    assert save_checkpoint(str(tmp_path / "b"), state) == 7  # defaults to state.step
