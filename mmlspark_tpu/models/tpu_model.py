@@ -203,14 +203,33 @@ class TPUModel(Transformer):
     def _run_chunks(self, rows: List[np.ndarray], jitted, dev_vars, mesh) -> List[np.ndarray]:
         """Feed same-shape rows through the executor; returns per-row outputs."""
         dp = mesh.shape["data"]
-        bs = max(self.batch_size, dp)
+        bs, pad_mult = self.chunk_sizes(len(rows), dp)
         dtype = np.uint8 if self.feed_dtype == "uint8" else np.float32
 
         def prep():
             for start in range(0, len(rows), bs):
                 chunk = np.stack(rows[start:start + bs]).astype(dtype, copy=False)
-                yield pad_to_multiple(chunk, dp, axis=0)
+                yield pad_to_multiple(chunk, pad_mult, axis=0)
 
+        return self.run_chunk_iter(prep(), jitted, dev_vars, mesh)
+
+    def chunk_sizes(self, n_rows: int, dp: int):
+        """(chunk_size, pad_multiple) for a group of n_rows: chunk size is
+        batch_size rounded up to the data-parallel degree; multi-chunk
+        groups pad every chunk (incl. the trailing one) to the full chunk
+        size so the whole group shares ONE compiled program (a fresh XLA
+        compile costs far more than the padded FLOPs), while a single-chunk
+        group pads only to the dp multiple.  Shared by the row path here and
+        ImageFeaturizer's streaming byte path so the two can never compile
+        different program shapes for the same data."""
+        bs = -(-max(self.batch_size, dp) // dp) * dp
+        return bs, (bs if n_rows > bs else dp)
+
+    def run_chunk_iter(self, chunk_iter, jitted, dev_vars, mesh) -> List[np.ndarray]:
+        """Drive (padded_chunk, n_valid) pairs through the executor with the
+        async double-buffered feed; returns the per-row outputs in order.
+        `chunk_iter` runs on the prefetch thread, so host-side chunk
+        assembly (decode, buffer fill) overlaps device compute."""
         outs: List[np.ndarray] = []
         inflight: List[Any] = []
 
@@ -218,9 +237,17 @@ class TPUModel(Transformer):
             y, n = inflight.pop(0)
             outs.append(np.asarray(y)[:n])
 
-        for padded, n in buffered_prefetch(prep(), self._INFLIGHT):
+        for padded, n in buffered_prefetch(chunk_iter, self._INFLIGHT):
             x = jax.device_put(padded, batch_sharding(mesh, padded.ndim))
-            inflight.append((jitted(dev_vars, x), n))
+            y = jitted(dev_vars, x)
+            try:
+                # start device->host DMA as soon as the result is ready so
+                # the fetch overlaps later chunks' transfer/compute instead
+                # of serializing at drain time
+                y.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass
+            inflight.append((y, n))
             if len(inflight) >= self._INFLIGHT:
                 drain_one()
         while inflight:

@@ -25,7 +25,8 @@ from typing import List, Optional, Sequence, Union
 import numpy as np
 
 __all__ = ["available", "build", "murmur3_batch", "histogram",
-           "load_csv_numeric", "decode_jpeg_bgr", "jpeg_available"]
+           "load_csv_numeric", "decode_jpeg_bgr", "decode_jpeg_bgr_into",
+           "jpeg_probe", "jpeg_available"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libmmlspark_native.so")
@@ -225,3 +226,48 @@ def decode_jpeg_bgr(data: bytes, scale_denom: int = 1) -> Optional[np.ndarray]:
     if rc != 0:
         return None
     return out.reshape(h.value, w.value, c.value)
+
+
+def jpeg_probe(data: bytes, scale_denom: int = 1):
+    """Header-only (h, w, c) of a JPEG stream (~µs, no pixel decode) — lets
+    callers group rows by output shape and preallocate batch buffers before
+    any decode.  None when unavailable/invalid/bomb-sized."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "mml_jpeg_probe"):
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    if len(buf) == 0:
+        return None
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    c = ctypes.c_int32()
+    rc = lib.mml_jpeg_probe(buf.ctypes.data, len(buf), int(scale_denom),
+                            ctypes.byref(h), ctypes.byref(w), ctypes.byref(c))
+    if rc != 0 or h.value * w.value > MAX_JPEG_PIXELS:
+        return None
+    return (h.value, w.value, c.value)
+
+
+def decode_jpeg_bgr_into(data: bytes, out: np.ndarray,
+                         scale_denom: int = 1) -> bool:
+    """Decode JPEG bytes directly into a preallocated HWC uint8 view (e.g.
+    one image slot of a [N,H,W,C] batch buffer) — no intermediate array, no
+    stack copy.  `out` must be C-contiguous and exactly match the decoded
+    (h, w, c).  Returns False on any mismatch or decode failure (caller
+    falls back / drops the row)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "mml_jpeg_decode_bgr"):
+        return False
+    if not out.flags["C_CONTIGUOUS"] or out.dtype != np.uint8:
+        raise ValueError("decode_jpeg_bgr_into: need C-contiguous uint8 out")
+    buf = np.frombuffer(data, np.uint8)
+    if len(buf) == 0:
+        return False
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    c = ctypes.c_int32()
+    rc = lib.mml_jpeg_decode_bgr(buf.ctypes.data, len(buf), int(scale_denom),
+                                 out.ctypes.data, out.nbytes,
+                                 ctypes.byref(h), ctypes.byref(w),
+                                 ctypes.byref(c))
+    return rc == 0 and out.shape == (h.value, w.value, c.value)

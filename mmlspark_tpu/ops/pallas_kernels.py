@@ -14,7 +14,7 @@ composition (ops.image.normalize + hwc_to_chw_flat).
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Sequence
 
 import jax
@@ -72,64 +72,124 @@ def _fused_normalize_unroll_pallas(batch, mean: tuple, std: tuple):
     return out.reshape(b, c * h * w)
 
 
-@partial(jax.jit, static_argnames=("h_out", "w_out", "mean", "std"))
+def _pad_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _resize_weights_np(n_in: int, n_out: int) -> np.ndarray:
+    """[n_out, n_in] numpy linear-interpolation weights, bit-matching
+    jax.image.resize(method="linear") (half-pixel centers, triangle kernel,
+    antialiased on downscale) — pure numpy so it is safe to call at trace
+    time inside an enclosing jit."""
+    if n_in == n_out:
+        return np.eye(n_in, dtype=np.float32)
+    scale = n_out / n_in
+    kernel_scale = max(1.0 / scale, 1.0)  # antialias widens on downscale
+    sample_f = (np.arange(n_out, dtype=np.float64) + 0.5) / scale - 0.5
+    x = np.abs(sample_f[None, :] - np.arange(n_in, dtype=np.float64)[:, None])
+    w = np.maximum(0.0, 1.0 - x / kernel_scale)     # triangle kernel
+    total = w.sum(axis=0, keepdims=True)
+    w = np.where(total > 0, w / np.where(total == 0, 1.0, total), 0.0)
+    return np.ascontiguousarray(w.T, dtype=np.float32)
+
+
+@lru_cache(maxsize=64)
+def _resize_consts(h_in: int, w_in: int, c: int, h_out: int, w_out: int,
+                   mean: tuple, std: tuple):
+    """Host-built (numpy) padded weight matrices for the 2D kernel."""
+    kin, kout = w_in * c, w_out * c
+    h_in_p, kin_p = _pad_up(h_in, 8), _pad_up(kin, 128)
+    h_out_p, kout_p = _pad_up(h_out, 8), _pad_up(kout, 128)
+    ry = _resize_weights_np(h_in, h_out)            # [h_out, h_in]
+    rx = _resize_weights_np(w_in, w_out)            # [w_out, w_in]
+    ry_p = np.zeros((h_out_p, h_in_p), np.float32)
+    ry_p[:h_out, :h_in] = ry
+    m = np.zeros((kin_p, kout_p), np.float32)
+    for ch in range(c):
+        m[ch:kin:c, ch:kout:c] = rx.T               # interleaved Rx^T
+    mean_t = np.zeros((1, kout_p), np.float32)
+    inv_t = np.zeros((1, kout_p), np.float32)
+    for ch in range(c):
+        mean_t[0, ch:kout:c] = mean[ch]
+        inv_t[0, ch:kout:c] = 1.0 / std[ch]
+    return ry_p, m, mean_t, inv_t
+
+
 def _fused_resize_normalize_pallas(batch, h_out: int, w_out: int,
                                    mean: tuple, std: tuple):
+    _, _, _, c = batch.shape
+    consts = _resize_consts(batch.shape[1], batch.shape[2], c,
+                            h_out, w_out, mean, std)
+    return _fused_resize_normalize_run(
+        batch, *map(jnp.asarray, consts), h_out=h_out, w_out=w_out)
+
+
+@partial(jax.jit, static_argnames=("h_out", "w_out"))
+def _fused_resize_normalize_run(batch, ry_p, m, mean_t, inv_t,
+                                *, h_out: int, w_out: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h_in, w_in, c = batch.shape
-    # separable bilinear resize as two dense matmuls: out = Ry @ X @ Rx^T.
-    # The weight matrices are the true jax.image.resize row weights
-    # (resizing an identity matrix along one axis), so the kernel is
-    # numerically the library resize — but cast + resize + normalize is one
-    # VMEM-resident pass (no full-size f32 intermediate in HBM), and the
-    # interpolation runs on the MXU.
-    ry = _resize_weights(h_in, h_out)               # [h_out, h_in]
-    rx = _resize_weights(w_in, w_out)               # [w_out, w_in]
-    mean_a = jnp.asarray(mean, jnp.float32).reshape(1, 1, c)
-    inv_std = jnp.asarray([1.0 / s for s in std], jnp.float32).reshape(1, 1, c)
+    # Mosaic-legal formulation: the HWC image is its natural 2D memory view
+    # [H, W*C] (channels interleaved along the lane dimension), so the whole
+    # kernel is plain 2D matmuls — no in-kernel reshape/transpose, which
+    # Mosaic's vector layouts reject for C=3-minor arrays.  Separable
+    # bilinear resize becomes out = Ry @ X @ M, where Ry is the true
+    # jax.image.resize height weights and M is the width weights interleaved
+    # per channel: M[w*c+ch, w'*c+ch'] = Rx[w', w] * (ch == ch').  Both
+    # operands are padded up to the (8, 128) tile grid; padded rows/cols
+    # carry zero weights so the result is exact, and the pads are sliced off
+    # outside the kernel (cheap XLA slice of the small output).
+    #
+    # One HBM read of the uint8 input + one HBM write of the f32 output per
+    # image: cast + resize + normalize never materialize full-size f32
+    # intermediates, and the interpolation runs on the MXU.
+    kin = w_in * c
+    kout = w_out * c
+    h_out_p, kout_p = ry_p.shape[0], m.shape[1]
+    h_in_p, kin_p = ry_p.shape[1], m.shape[0]
 
-    def kernel(x_ref, ry_ref, rx_ref, mean_ref, inv_ref, out_ref):
-        x = x_ref[0].astype(jnp.float32)            # [H, W, C]
-        t = jnp.dot(ry_ref[:], x.reshape(h_in, w_in * c),
-                    preferred_element_type=jnp.float32)      # [h, W*C]
-        t = t.reshape(h_out, w_in, c)
-        t = jnp.transpose(t, (1, 0, 2)).reshape(w_in, h_out * c)
-        u = jnp.dot(rx_ref[:], t,
-                    preferred_element_type=jnp.float32)      # [w, h*C]
-        u = jnp.transpose(u.reshape(w_out, h_out, c), (1, 0, 2))
+    x2 = batch.reshape(b, h_in, kin)
+    if (h_in_p, kin_p) != (h_in, kin):
+        x2 = jnp.pad(x2, ((0, 0), (0, h_in_p - h_in), (0, kin_p - kin)))
+
+    def kernel(x_ref, ry_ref, m_ref, mean_ref, inv_ref, out_ref):
+        x = x_ref[0]                                # [H_p, (W*C)_p]
+        if x.dtype == jnp.uint8:
+            # Mosaic can't lower uint8->float32 directly; widen via int32
+            # (uint8 values fit losslessly)
+            x = x.astype(jnp.int32)
+        x = x.astype(jnp.float32)
+        # HIGHEST: full-f32 accumulation on the MXU (3-pass bf16) — keeps
+        # the interpolation within one uint8 LSB of the XLA reference
+        t = jnp.dot(ry_ref[:], x, preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+        u = jnp.dot(t, m_ref[:], preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
         out_ref[0] = (u - mean_ref[:]) * inv_ref[:]
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, h_out, w_out, c), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, h_out_p, kout_p), jnp.float32),
         grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, h_in, w_in, c), lambda i: (i, 0, 0, 0),
+            pl.BlockSpec((1, h_in_p, kin_p), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((h_out, h_in), lambda i: (0, 0),
+            pl.BlockSpec((h_out_p, h_in_p), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((w_out, w_in), lambda i: (0, 0),
+            pl.BlockSpec((kin_p, kout_p), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, c), lambda i: (0, 0, 0),
+            pl.BlockSpec((1, kout_p), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, c), lambda i: (0, 0, 0),
+            pl.BlockSpec((1, kout_p), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, h_out, w_out, c), lambda i: (i, 0, 0, 0),
+        out_specs=pl.BlockSpec((1, h_out_p, kout_p), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
-    )(batch, ry, rx, mean_a, inv_std)
-
-
-def _resize_weights(n_in: int, n_out: int) -> jnp.ndarray:
-    """[n_out, n_in] linear-interpolation weights with jax.image.resize's
-    exact convention (resize the identity along one axis)."""
-    if n_in == n_out:
-        return jnp.eye(n_in, dtype=jnp.float32)
-    eye = jnp.eye(n_in, dtype=jnp.float32)
-    return jax.image.resize(eye, (n_out, n_in), method="linear")
+    )(x2, ry_p, m, mean_t, inv_t)
+    return out[:, :h_out, :kout].reshape(b, h_out, w_out, c)
 
 
 # one image must stage in VMEM (~16MB/core): input block + its f32 cast
@@ -140,7 +200,14 @@ PALLAS_IMAGE_VMEM_BUDGET = 8 * 1024 * 1024
 
 def _fits_vmem(in_shape, h_out: int, w_out: int, itemsize: int) -> bool:
     _, h, w, c = in_shape
-    staged = h * w * c * (itemsize + 4) + h_out * w_out * c * 4
+    kin, kout = _pad_up(w * c, 128), _pad_up(w_out * c, 128)
+    h_p, ho_p = _pad_up(h, 8), _pad_up(h_out, 8)
+    staged = (h_p * kin * (itemsize + 4)      # input block + f32 cast
+              + ho_p * h_p * 4                # height weights ry_p
+              + ho_p * kin * 4                # height-resized intermediate
+              + kin * kout * 4                # interleaved width weights
+              + 2 * kout * 4                  # mean / inv-std row vectors
+              + ho_p * kout * 4)              # output block
     return staged <= PALLAS_IMAGE_VMEM_BUDGET
 
 
@@ -175,13 +242,17 @@ def fused_normalize_unroll(batch: jnp.ndarray,
                            std: Sequence[float] = (1.0,)) -> jnp.ndarray:
     """(B, H, W, C) -> (B, C*H*W) with per-channel (x - mean) / std fused in.
 
-    Falls back to the XLA composition when Pallas is unavailable.
+    Uses the Pallas kernel in interpret mode off-TPU (the reference
+    semantics); on real TPU hardware it takes the XLA composition — the
+    C=3-minor (1,c,h,w) output block can never satisfy Mosaic's (8,128)
+    tile rules, and XLA already fuses normalize+transpose into one HBM
+    pass for this pattern.
     """
     batch = jnp.asarray(batch)
     c = batch.shape[-1]
     mean = tuple(float(m) for m in np.broadcast_to(np.asarray(mean), (c,)))
     std = tuple(float(s) for s in np.broadcast_to(np.asarray(std), (c,)))
-    if not pallas_available():  # pragma: no cover
+    if not pallas_available() or jax.default_backend() == "tpu":
         from .image import hwc_to_chw_flat, normalize
 
         return hwc_to_chw_flat(normalize(batch, mean, std))

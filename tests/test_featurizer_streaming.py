@@ -1,0 +1,182 @@
+"""ImageFeaturizer JPEG-bytes streaming fast path: native probe -> shape
+groups -> decode straight into chunk buffers on the prefetch thread.
+
+Mirrors the reference's decode->resize->forward stack
+(ImageFeaturizer.scala:137-184) with the host limited to codec work; the
+general (image-row) path is the parity reference for every case here.
+"""
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.io.image import array_to_image_row
+from mmlspark_tpu.models.bundle import FlaxBundle
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu import native
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    import jax.numpy as jnp
+
+    return FlaxBundle(
+        "resnet18", {"num_classes": 10, "dtype": jnp.float32},
+        input_shape=(32, 32, 3), seed=0,
+    )
+
+
+def _jpeg(arr: np.ndarray, quality: int = 95) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _png(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+jpeg_native = pytest.mark.skipif(
+    not native.jpeg_available(), reason="native libjpeg not built")
+
+
+@jpeg_native
+class TestStreamingFastPath:
+    def test_bytes_column_takes_streaming_path(self, bundle, rng, monkeypatch):
+        blobs = [_jpeg(rng.integers(0, 255, (40, 30, 3)).astype(np.uint8))
+                 for _ in range(5)]
+        f = ImageFeaturizer(bundle=bundle, batch_size=2)
+        called = {}
+        orig = ImageFeaturizer._transform_bytes_streaming
+
+        def spy(self, table, b):
+            called["yes"] = True
+            return orig(self, table, b)
+
+        monkeypatch.setattr(ImageFeaturizer, "_transform_bytes_streaming", spy)
+        out = f.transform(Table({"image": blobs, "id": np.arange(5)}))
+        assert called.get("yes"), "bytes column must take the streaming path"
+        assert out["features"].shape == (5, 512)
+
+    def test_matches_general_path(self, bundle, rng):
+        arrs = [rng.integers(0, 255, (40, 30, 3)).astype(np.uint8)
+                for _ in range(6)]
+        blobs = [_jpeg(a) for a in arrs]
+        f = ImageFeaturizer(bundle=bundle, batch_size=4)
+        streamed = f.transform(Table({"image": blobs}))
+        # general path on identical pixels (same native decoder, row input)
+        rows = Table({"image": [array_to_image_row(native.decode_jpeg_bgr(b))
+                                for b in blobs]})
+        general = f.transform(rows)
+        np.testing.assert_allclose(
+            streamed["features"], general["features"], rtol=2e-4, atol=2e-4)
+
+    def test_mixed_jpeg_png_and_shapes(self, bundle, rng):
+        cells = [
+            _jpeg(rng.integers(0, 255, (40, 30, 3)).astype(np.uint8)),
+            _png(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)),
+            _jpeg(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)),
+            _jpeg(rng.integers(0, 255, (40, 30), dtype=np.uint8)),  # gray
+        ]
+        out = ImageFeaturizer(bundle=bundle, batch_size=2).transform(
+            Table({"image": cells, "id": np.arange(4)}))
+        assert out["features"].shape == (4, 512)
+        assert list(out["id"]) == [0, 1, 2, 3]
+
+    def test_order_preserved_across_groups(self, bundle, rng):
+        # interleave two shape groups; features must scatter back by row
+        arrs = [rng.integers(0, 255, ((40, 30, 3) if i % 2 else (32, 32, 3)))
+                .astype(np.uint8) for i in range(8)]
+        blobs = [_jpeg(a) for a in arrs]
+        f = ImageFeaturizer(bundle=bundle, batch_size=3)
+        out = f.transform(Table({"image": blobs}))
+        for i in (0, 1, 7):
+            single = f.transform(Table({"image": [blobs[i]]}))
+            np.testing.assert_allclose(
+                out["features"][i], single["features"][0],
+                rtol=2e-4, atol=2e-4)
+
+    def test_cmyk_jpeg_falls_back_to_pil(self, bundle, rng):
+        # libjpeg can't emit BGR from CMYK/YCCK; the streaming path must
+        # PIL-fallback instead of dropping the row (decode_image parity)
+        cmyk = Image.new("CMYK", (30, 40))
+        cmyk.putdata([(int(i) % 256, 50, 100, 0)
+                      for i in rng.integers(0, 255, 30 * 40)])
+        buf = io.BytesIO()
+        cmyk.save(buf, format="JPEG")
+        good = _jpeg(rng.integers(0, 255, (40, 30, 3)).astype(np.uint8))
+        out = ImageFeaturizer(bundle=bundle, batch_size=2).transform(
+            Table({"image": [good, buf.getvalue()]}))
+        assert out.num_rows == 2
+        assert out["features"].shape == (2, 512)
+
+    def test_mostly_png_column_keeps_general_path(self, bundle, rng,
+                                                  monkeypatch):
+        blobs = [_png(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8))
+                 for _ in range(4)]
+        blobs.append(_jpeg(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)))
+
+        def boom(self, table, b):  # pragma: no cover
+            raise AssertionError("PNG-majority column took streaming path")
+
+        monkeypatch.setattr(
+            ImageFeaturizer, "_transform_bytes_streaming", boom)
+        out = ImageFeaturizer(bundle=bundle, batch_size=2).transform(
+            Table({"image": blobs}))
+        assert out["features"].shape == (5, 512)
+
+    def test_undecodable_rows_dropped(self, bundle, rng):
+        good = _jpeg(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8))
+        # valid header, truncated pixel data: probe succeeds, decode fails
+        truncated = good[: len(good) // 2]
+        cells = [good, b"not-an-image", truncated, None, good]
+        out = ImageFeaturizer(bundle=bundle, batch_size=2).transform(
+            Table({"image": cells, "id": np.arange(5)}))
+        assert out.num_rows == 2
+        assert list(out["id"]) == [0, 4]
+
+    def test_drop_na_false_raises(self, bundle, rng):
+        good = _jpeg(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8))
+        with pytest.raises(ValueError, match="undecodable"):
+            ImageFeaturizer(bundle=bundle, drop_na=False).transform(
+                Table({"image": [good, b"junk"]}))
+
+    def test_large_group_multi_chunk(self, bundle, rng):
+        # more rows than batch_size: trailing chunk pads to full bs, padded
+        # rows never leak into the output
+        blobs = [_jpeg(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8))
+                 for _ in range(7)]
+        out = ImageFeaturizer(bundle=bundle, batch_size=3).transform(
+            Table({"image": blobs}))
+        assert out["features"].shape == (7, 512)
+        single = ImageFeaturizer(bundle=bundle).transform(
+            Table({"image": [blobs[6]]}))
+        np.testing.assert_allclose(
+            out["features"][6], single["features"][0], rtol=2e-4, atol=2e-4)
+
+
+class TestDecodeInto:
+    @jpeg_native
+    def test_decode_into_matches_decode(self, rng):
+        arr = rng.integers(0, 255, (24, 18, 3)).astype(np.uint8)
+        blob = _jpeg(arr)
+        ref = native.decode_jpeg_bgr(blob)
+        out = np.zeros_like(ref)
+        assert native.decode_jpeg_bgr_into(blob, out)
+        np.testing.assert_array_equal(out, ref)
+
+    @jpeg_native
+    def test_decode_into_shape_mismatch_false(self, rng):
+        blob = _jpeg(rng.integers(0, 255, (24, 18, 3)).astype(np.uint8))
+        wrong = np.zeros((10, 10, 3), np.uint8)
+        assert not native.decode_jpeg_bgr_into(blob, wrong)
+
+    @jpeg_native
+    def test_probe(self, rng):
+        blob = _jpeg(rng.integers(0, 255, (24, 18, 3)).astype(np.uint8))
+        assert native.jpeg_probe(blob) == (24, 18, 3)
+        assert native.jpeg_probe(b"xx") is None
