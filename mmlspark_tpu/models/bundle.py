@@ -139,12 +139,15 @@ class FunctionBundle(ModelBundle):
         return out if isinstance(out, dict) else {"output": out}
 
 
-# register the resnet family
+# register the vision zoo (resnets + classic CNNs)
 def _register_defaults():
+    from . import convnets as C
     from . import resnet as R
 
     for name in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152"):
         register_builder(name, getattr(R, name))
+    for name in ("alexnet", "vgg11", "vgg16", "convnet_cifar"):
+        register_builder(name, getattr(C, name))
 
 
 _register_defaults()

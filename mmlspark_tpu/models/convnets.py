@@ -1,0 +1,142 @@
+"""Classic CNN zoo: AlexNet / VGG / CIFAR ConvNet in Flax.
+
+The reference's ModelDownloader ships CNTK zoo binaries beyond ResNet —
+AlexNet and plain ConvNets (SURVEY §2.9.6; deep-learning DownloaderSuite,
+docs model list).  These are their TPU-first equivalents: NHWC, bfloat16
+compute with float32 params, and the same `(logits, taps)` named-output
+contract as models/resnet.py so ImageFeaturizer's `cutOutputLayers`
+addressing (ImageFeaturizer.scala:40-197) works unchanged — taps are
+ordered output-backwards and `taps[layer_names[1]]` is always the
+penultimate feature vector.
+
+No LRN (obsolete; modern reimplementations drop it) and dropout is applied
+only when `train=True` (callers pass a 'dropout' rng then).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["AlexNet", "VGG", "ConvNetCifar", "alexnet", "vgg11", "vgg16",
+           "convnet_cifar"]
+
+
+def _classifier_head(x, taps, num_classes: int, dtype, train: bool,
+                     hidden: Sequence[int] = (4096, 4096)):
+    """Shared fc tail: hidden dense layers (last one is the 'pool' tap /
+    penultimate feature), per-layer train-time dropout, then the head.
+    Records 'fc1'/'pool'/'logits' taps with f32 dtype."""
+    for k, width in enumerate(hidden):
+        x = nn.relu(nn.Dense(width, dtype=dtype,
+                             name=f"fc{k + 1}")(x))
+        tap = "pool" if k == len(hidden) - 1 else f"fc{k + 1}"
+        taps[tap] = x.astype(jnp.float32)
+        if train:
+            x = nn.Dropout(0.5, deterministic=False)(x)
+    logits = nn.Dense(num_classes, dtype=dtype,
+                      name="head")(x).astype(jnp.float32)
+    taps["logits"] = logits
+    return logits
+
+
+class AlexNet(nn.Module):
+    """AlexNet (single-tower): 5 convs + 2 fc layers + head."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    layer_names = ["logits", "pool", "fc1", "conv5", "conv3", "conv1"]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        taps: Dict[str, jnp.ndarray] = {}
+        conv = functools.partial(nn.Conv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(96, (11, 11), (4, 4), padding=[(2, 2), (2, 2)],
+                         name="conv1")(x))
+        taps["conv1"] = x
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(256, (5, 5), padding=[(2, 2), (2, 2)],
+                         name="conv2")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, (3, 3), padding=[(1, 1), (1, 1)],
+                         name="conv3")(x))
+        taps["conv3"] = x
+        x = nn.relu(conv(384, (3, 3), padding=[(1, 1), (1, 1)],
+                         name="conv4")(x))
+        x = nn.relu(conv(256, (3, 3), padding=[(1, 1), (1, 1)],
+                         name="conv5")(x))
+        taps["conv5"] = x
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        logits = _classifier_head(x, taps, self.num_classes, self.dtype, train)
+        return logits, taps
+
+
+class VGG(nn.Module):
+    """VGG-style conv stacks; cfg is filters-per-stack (max_pool between)."""
+
+    cfg: Sequence[Sequence[int]]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    layer_names = ["logits", "pool", "fc1", "conv_out"]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        taps: Dict[str, jnp.ndarray] = {}
+        x = x.astype(self.dtype)
+        for s, widths in enumerate(self.cfg):
+            for k, w in enumerate(widths):
+                x = nn.relu(nn.Conv(w, (3, 3), padding=[(1, 1), (1, 1)],
+                                    dtype=self.dtype,
+                                    name=f"conv{s + 1}_{k + 1}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        taps["conv_out"] = x
+        x = x.reshape(x.shape[0], -1)
+        logits = _classifier_head(x, taps, self.num_classes, self.dtype, train)
+        return logits, taps
+
+
+class ConvNetCifar(nn.Module):
+    """The small ConvNet of the CIFAR tutorials (CNTK ConvNet_CIFAR10
+    shape): 3 conv/pool stages + one hidden dense."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+    layer_names = ["logits", "pool", "conv3", "conv2", "conv1"]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        taps: Dict[str, jnp.ndarray] = {}
+        x = x.astype(self.dtype)
+        for i, w in enumerate((64, 128, 256)):
+            x = nn.relu(nn.Conv(w, (3, 3), padding=[(1, 1), (1, 1)],
+                                dtype=self.dtype, name=f"conv{i + 1}a")(x))
+            x = nn.relu(nn.Conv(w, (3, 3), padding=[(1, 1), (1, 1)],
+                                dtype=self.dtype, name=f"conv{i + 1}b")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            taps[f"conv{i + 1}"] = x
+        x = x.reshape(x.shape[0], -1)
+        logits = _classifier_head(x, taps, self.num_classes, self.dtype,
+                                  train, hidden=(512,))
+        return logits, taps
+
+
+def alexnet(num_classes=1000, dtype=jnp.bfloat16):
+    return AlexNet(num_classes, dtype)
+
+
+def vgg11(num_classes=1000, dtype=jnp.bfloat16):
+    return VGG(((64,), (128,), (256, 256), (512, 512), (512, 512)),
+               num_classes, dtype)
+
+
+def vgg16(num_classes=1000, dtype=jnp.bfloat16):
+    return VGG(((64, 64), (128, 128), (256, 256, 256),
+                (512, 512, 512), (512, 512, 512)), num_classes, dtype)
+
+
+def convnet_cifar(num_classes=10, dtype=jnp.bfloat16):
+    return ConvNetCifar(num_classes, dtype)

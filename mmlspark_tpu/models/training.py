@@ -18,7 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_sharding, default_mesh, replicated_sharding
 
-__all__ = ["TrainState", "make_train_step", "make_eval_step", "fit_epochs", "shard_params"]
+__all__ = ["TrainState", "make_train_step", "make_train_epoch",
+           "make_eval_step", "fit_epochs", "shard_params"]
 
 
 class TrainState:
@@ -62,28 +63,27 @@ def softmax_cross_entropy(logits, labels, num_classes):
     return optax.softmax_cross_entropy(logits, one_hot).mean()
 
 
-def make_train_step(
-    model,
-    optimizer,
-    num_classes: int,
-    mesh: Optional[Mesh] = None,
-    donate: bool = True,
-):
-    """Build `step(state, images, labels) -> (state, metrics)`, jitted with
-    batch-sharded inputs.  `model.apply` must accept
-    (variables, x, train=True, mutable=['batch_stats'])."""
-    mesh = mesh or default_mesh()
+def _step_body(model, optimizer, num_classes, seed: int = 0):
+    """The un-jitted SGD step shared by make_train_step (one dispatch per
+    step) and make_train_epoch (lax.scan over many steps in one dispatch)."""
 
     def step(state: TrainState, images, labels):
+        # deterministic per-step dropout key (scan-safe: derived from the
+        # traced step counter); models without dropout just ignore it, and
+        # models without BatchNorm yield no 'batch_stats' updates
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+
         def loss_fn(params):
             (logits, _taps), updates = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
                 images,
                 train=True,
                 mutable=["batch_stats"],
+                rngs={"dropout": rng},
             )
             loss = softmax_cross_entropy(logits, labels, num_classes)
-            return loss, (logits, updates["batch_stats"])
+            return loss, (logits, updates.get("batch_stats",
+                                              state.batch_stats))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -96,10 +96,61 @@ def make_train_step(
             {"loss": loss, "accuracy": acc},
         )
 
+    return step
+
+
+def make_train_step(
+    model,
+    optimizer,
+    num_classes: int,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+    seed: int = 0,
+):
+    """Build `step(state, images, labels) -> (state, metrics)`, jitted with
+    batch-sharded inputs.  `model.apply` must accept
+    (variables, x, train=True, mutable=['batch_stats']).  `seed` varies the
+    dropout mask stream (per-step keys are folded from it)."""
+    mesh = mesh or default_mesh()
+    step = _step_body(model, optimizer, num_classes, seed)
     img_sh = batch_sharding(mesh, 4)
     lbl_sh = batch_sharding(mesh, 1)
     return jax.jit(
         step,
+        in_shardings=(None, img_sh, lbl_sh),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_train_epoch(
+    model,
+    optimizer,
+    num_classes: int,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+    seed: int = 0,
+):
+    """Build `epoch(state, images, labels) -> (state, metrics)` running a
+    whole stack of minibatches ([S, B, ...] / [S, B]) as ONE jitted
+    `lax.scan` — one host dispatch for S optimizer steps, so per-call
+    latency (remote/tunneled chips, slow interconnects) never gates the
+    train loop and XLA keeps state resident on device across steps.
+    Metrics are per-step stacks ([S] arrays); batches stay sharded over the
+    mesh 'data' axis (leading scan axis replicated)."""
+    mesh = mesh or default_mesh()
+    step = _step_body(model, optimizer, num_classes, seed)
+
+    def epoch(state: TrainState, images, labels):
+        def body(carry, batch):
+            new_state, m = step(carry, batch[0], batch[1])
+            return new_state, m
+
+        return jax.lax.scan(body, state, (images, labels))
+
+    img_sh = NamedSharding(mesh, P(None, "data"))
+    lbl_sh = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(
+        epoch,
         in_shardings=(None, img_sh, lbl_sh),
         donate_argnums=(0,) if donate else (),
     )
@@ -116,14 +167,19 @@ def make_eval_step(model, mesh: Optional[Mesh] = None):
 
 
 def init_train_state(model, optimizer, input_shape, seed: int = 0) -> TrainState:
-    variables = model.init(
-        {"params": jax.random.PRNGKey(seed)},
-        jnp.zeros((1, *input_shape), jnp.float32),
-        train=False,
-    )
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
-    return TrainState(params, batch_stats, optimizer.init(params))
+    def _init():
+        variables = model.init(
+            {"params": jax.random.PRNGKey(seed)},
+            jnp.zeros((1, *input_shape), jnp.float32),
+            train=False,
+        )
+        params = variables["params"]
+        return params, variables.get("batch_stats", {}), optimizer.init(params)
+
+    # one compiled program instead of hundreds of eager init ops — eager
+    # dispatch is pathological on high-latency (tunneled/remote) devices
+    params, batch_stats, opt_state = jax.jit(_init)()
+    return TrainState(params, batch_stats, opt_state)
 
 
 def fit_epochs(
@@ -136,10 +192,15 @@ def fit_epochs(
     mesh: Optional[Mesh] = None,
     seed: int = 0,
     log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    epoch_fn=None,
 ) -> Tuple[TrainState, Dict[str, float]]:
     """Simple epoch loop over a host-resident dataset.  `batch_size` must be
     divisible by the mesh's data-parallel degree (static shapes; the remainder
-    of each epoch is dropped, standard for training loops)."""
+    of each epoch is dropped, standard for training loops).
+
+    With `epoch_fn` (from make_train_epoch) each epoch's shuffled batches are
+    stacked [S, B, ...] and run as one scanned dispatch; `step_fn` is then
+    only kept for callers that still want per-step logging."""
     mesh = mesh or default_mesh()
     dp = mesh.shape["data"]
     if batch_size % dp != 0:
@@ -151,8 +212,22 @@ def fit_epochs(
         )
     rng = np.random.default_rng(seed)
     metrics: Dict[str, float] = {}
+    img_sh = NamedSharding(mesh, P(None, "data"))
     for _epoch in range(epochs):
         order = rng.permutation(n)
+        if epoch_fn is not None:
+            steps = n // batch_size
+            idx = order[: steps * batch_size]
+            bi = jax.device_put(
+                images[idx].reshape(steps, batch_size, *images.shape[1:]),
+                img_sh)
+            bl = jax.device_put(
+                labels[idx].reshape(steps, batch_size), img_sh)
+            state, ms = epoch_fn(state, bi, bl)
+            metrics = {k: float(np.asarray(v)[-1]) for k, v in ms.items()}
+            if log_fn:
+                log_fn(int(state.step), metrics)
+            continue
         for start in range(0, n - batch_size + 1, batch_size):
             idx = order[start : start + batch_size]
             bi = jax.device_put(images[idx], batch_sharding(mesh, 4))

@@ -93,7 +93,7 @@ def _resize_weights_np(n_in: int, n_out: int) -> np.ndarray:
     return np.ascontiguousarray(w.T, dtype=np.float32)
 
 
-@lru_cache(maxsize=64)
+@lru_cache(maxsize=8)  # entries hold multi-MB weight matrices; keep small
 def _resize_consts(h_in: int, w_in: int, c: int, h_out: int, w_out: int,
                    mean: tuple, std: tuple):
     """Host-built (numpy) padded weight matrices for the 2D kernel."""

@@ -1,0 +1,87 @@
+"""Classic CNN zoo (AlexNet / VGG / ConvNetCifar): taps contract +
+ImageFeaturizer integration (SURVEY §2.9.6 zoo parity)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.bundle import FlaxBundle
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu.io.image import array_to_image_row
+
+
+@pytest.mark.parametrize("builder,input_hw,kw", [
+    ("alexnet", (63, 63), {"num_classes": 7}),
+    ("vgg11", (32, 32), {"num_classes": 7}),
+    ("convnet_cifar", (32, 32), {"num_classes": 7}),
+])
+def test_taps_contract(builder, input_hw, kw):
+    h, w = input_hw
+    bundle = FlaxBundle(builder, {**kw, "dtype": jnp.float32},
+                        input_shape=(h, w, 3), seed=0)
+    x = jnp.zeros((2, h, w, 3), jnp.float32)
+    taps = bundle.apply(bundle.variables, x)
+    assert bundle.layer_names[0] == "logits"
+    assert bundle.layer_names[1] == "pool"
+    for name in bundle.layer_names:
+        assert name in taps, f"{builder}: missing tap {name}"
+    assert taps["logits"].shape == (2, 7)
+    assert taps["pool"].ndim == 2  # penultimate feature vector
+
+
+def test_featurizer_on_convnet(rng):
+    bundle = FlaxBundle("convnet_cifar", {"num_classes": 10, "dtype": jnp.float32},
+                        input_shape=(32, 32, 3), seed=0)
+    rows = [array_to_image_row(rng.integers(0, 255, (32, 32, 3)).astype(np.uint8))
+            for _ in range(3)]
+    out = ImageFeaturizer(bundle=bundle, batch_size=2).transform(
+        Table({"image": rows}))
+    assert out["features"].shape == (3, 512)
+    logits = ImageFeaturizer(bundle=bundle, cut_output_layers=0).transform(
+        Table({"image": rows}))
+    assert logits["features"].shape == (3, 10)
+
+
+def test_training_factories_handle_dropout_and_no_batchnorm(rng):
+    # BatchNorm-free + dropout models must train through the shared
+    # factories (step and scanned-epoch): per-step dropout rng is derived
+    # from the step counter, batch_stats updates are optional
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.models.convnets import convnet_cifar
+    from mmlspark_tpu.models.training import (
+        init_train_state, make_train_epoch, make_train_step)
+    from mmlspark_tpu.parallel.mesh import MeshContext, batch_sharding, make_mesh
+
+    mesh = make_mesh(data=8)
+    model = convnet_cifar(num_classes=10, dtype=jnp.float32)
+    opt = optax.sgd(0.05)
+    imgs = rng.normal(size=(2, 16, 16, 16, 3)).astype(np.float32)
+    lbls = rng.integers(0, 10, size=(2, 16)).astype(np.int32)
+    with MeshContext(mesh):
+        state = init_train_state(model, opt, (16, 16, 3), seed=0)
+        step = make_train_step(model, opt, 10, mesh=mesh, donate=False)
+        state, m = step(state,
+                        jax.device_put(imgs[0], batch_sharding(mesh, 4)),
+                        jax.device_put(lbls[0], batch_sharding(mesh, 1)))
+        assert np.isfinite(float(m["loss"]))
+        epoch = make_train_epoch(model, opt, 10, mesh=mesh, donate=False)
+        sh = NamedSharding(mesh, P(None, "data"))
+        state, ms = epoch(state, jax.device_put(imgs, sh),
+                          jax.device_put(lbls, sh))
+        assert np.all(np.isfinite(np.asarray(ms["loss"])))
+        assert int(state.step) == 3
+
+
+def test_train_flag_uses_dropout_rng():
+    bundle = FlaxBundle("convnet_cifar", {"num_classes": 4, "dtype": jnp.float32},
+                        input_shape=(16, 16, 3), seed=0)
+    m = bundle.module
+    x = jnp.ones((2, 16, 16, 3), jnp.float32)
+    out1, _ = m.apply(bundle.variables, x, train=True,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    out2, _ = m.apply(bundle.variables, x, train=False)
+    assert out1.shape == out2.shape == (2, 4)
