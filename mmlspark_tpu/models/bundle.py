@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["ModelBundle", "FlaxBundle", "FunctionBundle", "register_builder"]
+__all__ = ["ModelBundle", "FlaxBundle", "FunctionBundle", "register_builder",
+           "get_builder"]
 
 # name -> (module factory, layer names) — grows as model families are added
 _BUILDERS: Dict[str, Callable[..., Any]] = {}
@@ -24,6 +25,17 @@ _BUILDERS: Dict[str, Callable[..., Any]] = {}
 def register_builder(name: str, factory: Callable[..., Any]):
     _BUILDERS[name] = factory
     return factory
+
+
+def get_builder(name: str) -> Callable[..., Any]:
+    """Look up a registered model builder by name; ValueError lists the
+    registry on a miss (the public face of the zoo registry)."""
+    try:
+        return _BUILDERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model builder {name!r}; registered: "
+            f"{sorted(_BUILDERS)}") from None
 
 
 def _to_numpy(tree):
@@ -92,8 +104,7 @@ class FlaxBundle(ModelBundle):
     @property
     def module(self):
         if self._module is None:
-            factory = _BUILDERS[self.builder]
-            self._module = factory(**self.builder_kwargs)
+            self._module = get_builder(self.builder)(**self.builder_kwargs)
         return self._module
 
     @property

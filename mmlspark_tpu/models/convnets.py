@@ -51,6 +51,12 @@ class AlexNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        if x.shape[1] < 63 or x.shape[2] < 63:
+            raise ValueError(
+                f"AlexNet needs inputs of at least 63x63 (three stride-2 "
+                f"3x3 pools after a stride-4 conv); got "
+                f"{x.shape[1]}x{x.shape[2]} — resize up or pick a "
+                f"small-input backbone (convnet_cifar, resnet18)")
         taps: Dict[str, jnp.ndarray] = {}
         conv = functools.partial(nn.Conv, dtype=self.dtype)
         x = x.astype(self.dtype)

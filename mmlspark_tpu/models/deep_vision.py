@@ -42,8 +42,8 @@ def _decode_column(col: np.ndarray) -> List[Optional[np.ndarray]]:
 class DeepVisionClassifier(Estimator):
     """Fine-tune a ResNet on (image, label) rows, data-parallel on the mesh."""
 
-    backbone = Param("resnet18|resnet34|resnet50|resnet101|resnet152",
-                     default="resnet18")
+    backbone = Param("any registered vision builder (resnet18/34/50/101/152, "
+                     "alexnet, vgg11/16, convnet_cifar)", default="resnet18")
     input_col = Param("image column (image rows / encoded bytes / arrays)",
                       default="image")
     label_col = Param("label column", default="label")
@@ -75,8 +75,8 @@ class DeepVisionClassifier(Estimator):
         import optax
 
         from ..parallel.mesh import MeshContext, batch_sharding, default_mesh
-        from . import resnet as resnet_mod
-        from .training import TrainState, init_train_state
+        from .bundle import get_builder
+        from .training import TrainState, init_train_state, scan_slice_steps
 
         labels_raw = table[self.label_col]
         classes = sorted({v for v in np.asarray(labels_raw).tolist()})
@@ -118,7 +118,7 @@ class DeepVisionClassifier(Estimator):
                              "rows in the input table")
         x = np.stack([to_hw(arrays[i]) for i in keep]).astype(np.uint8)
 
-        builder = getattr(resnet_mod, self.backbone)
+        builder = get_builder(self.backbone)
         model = builder(num_classes=num_classes, dtype=jnp.bfloat16)
         opt = optax.sgd(float(self.learning_rate), momentum=float(self.momentum))
         mesh = default_mesh()
@@ -131,17 +131,23 @@ class DeepVisionClassifier(Estimator):
         pre = ImagePreprocess(h, w, mean=mean, std=std)
 
         def step_fn(state: TrainState, images_u8, labels):
+            # per-step dropout key folded from the traced step counter
+            # (scan-safe); ignored by dropout-free backbones
+            drop_rng = jax.random.fold_in(
+                jax.random.PRNGKey(int(self.seed)), state.step)
+
             def loss_fn(params):
                 xb = pre(images_u8).astype(jnp.bfloat16)
                 (logits, _taps), updates = model.apply(
                     {"params": params, "batch_stats": state.batch_stats},
-                    xb, train=True, mutable=["batch_stats"])
+                    xb, train=True, mutable=["batch_stats"],
+                    rngs={"dropout": drop_rng})
                 one_hot = jax.nn.one_hot(labels, num_classes)
                 # -1 labels are batch padding: zero their loss weight
                 wgt = (labels >= 0).astype(jnp.float32)
                 losses = optax.softmax_cross_entropy(logits, one_hot)
                 loss = (losses * wgt).sum() / jnp.maximum(wgt.sum(), 1.0)
-                return loss, updates["batch_stats"]
+                return loss, updates.get("batch_stats", state.batch_stats)
 
             (loss, new_stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
@@ -167,37 +173,50 @@ class DeepVisionClassifier(Estimator):
                     # (clear it to retrain from scratch)
                     state = ckpt.restore(latest, template=state)
                     start_epoch = min(int(latest), int(self.epochs))
-            step = jax.jit(step_fn,
-                           in_shardings=(None, batch_sharding(mesh, 4),
-                                         batch_sharding(mesh, 1)),
-                           donate_argnums=(0,))
-            img_sh = batch_sharding(mesh, 4)
-            lbl_sh = batch_sharding(mesh, 1)
+            # one scanned dispatch per epoch: every minibatch of the epoch
+            # rides a single lax.scan program, so per-call latency (remote
+            # chips) never gates the fit and state stays device-resident
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def epoch_fn(state, images_s, labels_s):
+                def body(carry, batch):
+                    new_state, loss = step_fn(carry, batch[0], batch[1])
+                    return new_state, loss
+
+                return jax.lax.scan(body, state, (images_s, labels_s))
+
+            epoch = jax.jit(
+                epoch_fn,
+                in_shardings=(None, NamedSharding(mesh, P(None, "data")),
+                              NamedSharding(mesh, P(None, "data"))),
+                donate_argnums=(0,))
+            sh = NamedSharding(mesh, P(None, "data"))
             history = []
             # the shuffle stream must be reproducible across a resume:
             # replay the epochs already consumed
             for _ in range(start_epoch):
                 rng.permutation(len(x))
+            n_steps = -(-len(x) // bs)
+            # bounded scan slices: device memory stays O(slice) for datasets
+            # larger than HBM; at most two compiled shapes across the fit
+            k = scan_slice_steps(n_steps, bs * int(np.prod(x.shape[1:])) + bs * 4)
             for _epoch in range(start_epoch, int(self.epochs)):
                 order = rng.permutation(len(x))
+                # pad the tail batch to the FULL batch size (one compiled
+                # shape for the whole fit); -1 labels carry zero loss
+                pad = n_steps * bs - len(order)
+                idx = np.concatenate([order, order[-1:].repeat(pad)])
+                xb = x[idx].reshape(n_steps, bs, *x.shape[1:])
+                yb = np.concatenate(
+                    [y[order], np.full(pad, -1, np.int32)]
+                ).reshape(n_steps, bs)
                 losses = []
-                for start in range(0, len(order), bs):
-                    idx = order[start:start + bs]
-                    # pad the tail batch to the FULL batch size (one compiled
-                    # shape for the whole fit); -1 labels carry zero loss
-                    xb = x[idx]
-                    yb = y[idx]
-                    if len(xb) < bs:
-                        pad = bs - len(xb)
-                        xb = np.concatenate(
-                            [xb, np.repeat(xb[-1:], pad, axis=0)])
-                        yb = np.concatenate(
-                            [yb, np.full(pad, -1, np.int32)])
-                    state, loss = step(state,
-                                       jax.device_put(xb, img_sh),
-                                       jax.device_put(yb, lbl_sh))
-                    losses.append(loss)
-                history.append(float(np.mean([np.asarray(l) for l in losses])))
+                for s in range(0, n_steps, k):
+                    state, ls = epoch(state,
+                                      jax.device_put(xb[s : s + k], sh),
+                                      jax.device_put(yb[s : s + k], sh))
+                    losses.append(np.asarray(ls))
+                history.append(float(np.mean(np.concatenate(losses))))
                 if ckpt is not None:
                     # the host copy decouples the buffers from the donated
                     # jit state, so the orbax write can proceed async; the

@@ -19,7 +19,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh import batch_sharding, default_mesh, replicated_sharding
 
 __all__ = ["TrainState", "make_train_step", "make_train_epoch",
-           "make_eval_step", "fit_epochs", "shard_params"]
+           "make_eval_step", "fit_epochs", "shard_params",
+           "scan_slice_steps"]
+
+# device-memory budget for one scanned slice of training data; a full
+# epoch is scanned in slices of at most this many bytes so device memory
+# stays O(slice), not O(dataset)
+SCAN_SLICE_BYTES = 256 * 1024 * 1024
+
+
+def scan_slice_steps(n_steps: int, bytes_per_step: int,
+                     budget: int = SCAN_SLICE_BYTES) -> int:
+    """How many steps of stacked minibatches fit one scanned dispatch."""
+    return max(1, min(n_steps, budget // max(1, bytes_per_step)))
 
 
 class TrainState:
@@ -218,13 +230,19 @@ def fit_epochs(
         if epoch_fn is not None:
             steps = n // batch_size
             idx = order[: steps * batch_size]
-            bi = jax.device_put(
-                images[idx].reshape(steps, batch_size, *images.shape[1:]),
-                img_sh)
-            bl = jax.device_put(
-                labels[idx].reshape(steps, batch_size), img_sh)
-            state, ms = epoch_fn(state, bi, bl)
-            metrics = {k: float(np.asarray(v)[-1]) for k, v in ms.items()}
+            bi = images[idx].reshape(steps, batch_size, *images.shape[1:])
+            bl = labels[idx].reshape(steps, batch_size)
+            # scan in bounded slices: device memory stays O(slice) even for
+            # datasets far larger than HBM; at most two compiled shapes
+            # (the full slice and one remainder) across the whole fit
+            k = scan_slice_steps(steps, bi[0].nbytes + bl[0].nbytes)
+            for s in range(0, steps, k):
+                state, ms = epoch_fn(
+                    state,
+                    jax.device_put(bi[s : s + k], img_sh),
+                    jax.device_put(bl[s : s + k], img_sh),
+                )
+            metrics = {k2: float(np.asarray(v)[-1]) for k2, v in ms.items()}
             if log_fn:
                 log_fn(int(state.step), metrics)
             continue

@@ -85,3 +85,26 @@ def test_train_flag_uses_dropout_rng():
                       rngs={"dropout": jax.random.PRNGKey(1)})
     out2, _ = m.apply(bundle.variables, x, train=False)
     assert out1.shape == out2.shape == (2, 4)
+    # dropout must actually fire under train=True (p=0.5 on nonzero
+    # activations makes identical outputs essentially impossible)
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+    # and be deterministic per rng
+    out3, _ = m.apply(bundle.variables, x, train=True,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out3))
+
+
+def test_alexnet_rejects_tiny_inputs():
+    bundle_ok = FlaxBundle("alexnet", {"num_classes": 3, "dtype": jnp.float32},
+                           input_shape=(63, 63, 3), seed=0)
+    assert bundle_ok.variables
+    with pytest.raises(ValueError, match="at least 63x63"):
+        FlaxBundle("alexnet", {"num_classes": 3, "dtype": jnp.float32},
+                   input_shape=(32, 32, 3), seed=0)
+
+
+def test_get_builder_unknown_name_lists_registry():
+    from mmlspark_tpu.models.bundle import get_builder
+
+    with pytest.raises(ValueError, match="vgg16"):
+        get_builder("vgg19")

@@ -123,3 +123,16 @@ def test_transform_empty_and_mixed_channels():
     mixed = Table({"image": rows, "label": np.asarray([float(i % 2) for i in range(8)])})
     m2 = DeepVisionClassifier(backbone="resnet18", epochs=1, batch_size=8).fit(mixed)
     assert len(m2.transform(mixed)) == 8
+
+
+def test_dropout_backbone_finetunes():
+    # convnet_cifar has dropout and no BatchNorm: the scanned fit loop must
+    # supply a per-step dropout rng and tolerate empty batch_stats
+    t = _color_dataset(24)
+    model = DeepVisionClassifier(backbone="convnet_cifar", epochs=2,
+                                 batch_size=8, learning_rate=0.05,
+                                 seed=0).fit(t)
+    assert len(model.loss_history) == 2
+    assert np.isfinite(model.loss_history[-1])
+    out = model.transform(t)
+    assert out["probability"].shape == (24, 2)
