@@ -194,6 +194,8 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     pallas_fallback = False
     try:
         feat.transform(table)  # warm: compile one program per shape group
+    except TimeoutError:
+        raise  # the wall-clock cap must reach main()'s stale-fallback
     except Exception as e:  # noqa: BLE001 — a Mosaic rejection of the fused
         # preprocessing kernel must not cost the round its benchmark: retry
         # on the plain-XLA feed and record the fallback in the result so a
@@ -246,28 +248,49 @@ def main():
         with open(BASELINE_FILE) as f:
             baseline = json.load(f).get("cpu_images_per_sec")
 
-    if not _probe_backend():
-        # chip unreachable: report the last good measurement, marked stale
+    def _report_stale(reason: str):
         if os.path.exists(LASTGOOD_FILE):
             with open(LASTGOOD_FILE) as f:
                 last = json.load(f)
             last["stale"] = True
-            last["error"] = "TPU backend unavailable; last good measurement"
+            last["error"] = reason
             print(json.dumps(last))
         else:
             print(json.dumps({
                 "metric": "resnet50_imagefeaturizer_images_per_sec_per_chip",
                 "value": None, "unit": "images/sec", "vs_baseline": None,
-                "error": "TPU backend unavailable and no cached measurement",
+                "error": reason + " and no cached measurement",
             }))
+
+    if not _probe_backend():
+        # chip unreachable: report the last good measurement, marked stale
+        _report_stale("TPU backend unavailable; last good measurement")
         return
 
-    res = _measure(N_E2E, BATCH, ITERS)
+    # the tunnel can also die MID-measure (after a clean probe): a hard
+    # wall-clock cap converts that hang into a stale-last-good record
+    # instead of a lost round artifact
+    import signal
+
+    def _alarm(_sig, _frm):
+        raise TimeoutError("measurement wall-clock cap hit")
+
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(1200)
+    try:
+        res = _measure(N_E2E, BATCH, ITERS)
+    except Exception as e:  # noqa: BLE001 — any mid-measure failure
+        signal.alarm(0)
+        _report_stale(f"measurement failed mid-run ({e}); last good")
+        return
+    signal.alarm(900)  # fresh cap for the train segment
     try:
         train = _measure_train()
     except Exception as e:  # noqa: BLE001 — train bench must not kill the record
         train = {"train_samples_per_sec": None,
                  "train_error": str(e)[-200:]}
+    finally:
+        signal.alarm(0)
     record = {
         "metric": "resnet50_imagefeaturizer_images_per_sec_per_chip",
         "value": res["value"],

@@ -99,6 +99,18 @@ class _BatchedImageStage(Transformer):
             for a, r in zip(out_batch, src_rows)
         ]
 
+    def _run_group(self, batch: np.ndarray) -> np.ndarray:
+        """One same-shape float32 batch -> output batch.  Base: the jitted
+        op-list composition, cached per stage instance AND current param
+        values — a param mutation after a transform invalidates the cache
+        (jit re-specializes per input shape as usual)."""
+        token = repr(sorted(self.simple_param_values().items()))
+        cached = self.__dict__.get("_jitted_pipeline")
+        if cached is None or cached[0] != token:
+            cached = (token, jax.jit(self._pipeline_fn()))
+            self.__dict__["_jitted_pipeline"] = cached
+        return np.asarray(cached[1](jnp.asarray(batch)))
+
     def _transform(self, table: Table) -> Table:
         out_col = self.output_col or self.input_col
         cells = [_decode_cell(v) for v in table[self.input_col]]
@@ -107,11 +119,10 @@ class _BatchedImageStage(Transformer):
         valid = np.empty(len(valid_idx), dtype=object)
         for j, i in enumerate(valid_idx):
             valid[j] = cells[i]
-        fn = jax.jit(self._pipeline_fn())
         for _shape, members in _rows_to_shape_groups(valid).items():
             rows = [valid[m] for m in members]
             batch = np.stack([image_row_to_array(r) for r in rows]).astype(np.float32)
-            out = np.asarray(fn(jnp.asarray(batch)))
+            out = self._run_group(batch)
             for r_out, m in zip(self._emit(out, rows), members):
                 result[valid_idx[m]] = r_out
         return table.with_column(out_col, result)
@@ -125,6 +136,11 @@ class ImageTransformer(_BatchedImageStage):
     """
 
     stages = Param("list of [op_name, kwargs] pairs", default=None)
+    fuse = Param(
+        "fold the whole op list into ONE two-matmul Pallas pass when every "
+        "op is separable-linear (crop/resize/flip/blur/color/normalize): "
+        "None = auto (real TPU only), False = always the XLA composition",
+        default=None)
 
     _OPS = {
         "resize": lambda b, height, width, method="linear": I.resize(b, height, width, method),
@@ -193,6 +209,26 @@ class ImageTransformer(_BatchedImageStage):
             return batch
 
         return run
+
+    def _fuse_wanted(self) -> bool:
+        f = self.get_or_default("fuse")
+        if f is False:
+            return False
+        if f is None:  # auto: interpret-mode Pallas on CPU is slower than XLA
+            return jax.default_backend() == "tpu"
+        return True
+
+    def _run_group(self, batch: np.ndarray) -> np.ndarray:
+        if self._fuse_wanted():
+            from .pallas_kernels import (
+                affine_plan, freeze_stages, fused_affine_apply)
+
+            plan = affine_plan(freeze_stages(self.stages),
+                               *batch.shape[1:])
+            if plan is not None:
+                return np.asarray(fused_affine_apply(jnp.asarray(batch),
+                                                     plan))
+        return super()._run_group(batch)
 
 
 @register_stage

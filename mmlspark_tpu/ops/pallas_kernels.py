@@ -15,7 +15,7 @@ composition (ops.image.normalize + hwc_to_chw_flat).
 from __future__ import annotations
 
 from functools import lru_cache, partial
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -96,23 +96,14 @@ def _resize_weights_np(n_in: int, n_out: int) -> np.ndarray:
 @lru_cache(maxsize=8)  # entries hold multi-MB weight matrices; keep small
 def _resize_consts(h_in: int, w_in: int, c: int, h_out: int, w_out: int,
                    mean: tuple, std: tuple):
-    """Host-built (numpy) padded weight matrices for the 2D kernel."""
-    kin, kout = w_in * c, w_out * c
-    h_in_p, kin_p = _pad_up(h_in, 8), _pad_up(kin, 128)
-    h_out_p, kout_p = _pad_up(h_out, 8), _pad_up(kout, 128)
-    ry = _resize_weights_np(h_in, h_out)            # [h_out, h_in]
-    rx = _resize_weights_np(w_in, w_out)            # [w_out, w_in]
-    ry_p = np.zeros((h_out_p, h_in_p), np.float32)
-    ry_p[:h_out, :h_in] = ry
-    m = np.zeros((kin_p, kout_p), np.float32)
-    for ch in range(c):
-        m[ch:kin:c, ch:kout:c] = rx.T               # interleaved Rx^T
-    mean_t = np.zeros((1, kout_p), np.float32)
-    inv_t = np.zeros((1, kout_p), np.float32)
-    for ch in range(c):
-        mean_t[0, ch:kout:c] = mean[ch]
-        inv_t[0, ch:kout:c] = 1.0 / std[ch]
-    return ry_p, m, mean_t, inv_t
+    """Host-built (numpy) padded weight matrices for the 2D kernel — the
+    resize+normalize special case of _affine_consts (identity channel mix)."""
+    return _affine_consts(
+        _resize_weights_np(h_in, h_out),
+        _resize_weights_np(w_in, w_out),
+        np.eye(c, dtype=np.float32),
+        np.asarray(mean, np.float32),
+        (1.0 / np.asarray(std, np.float32)).astype(np.float32))
 
 
 def _fused_resize_normalize_pallas(batch, h_out: int, w_out: int,
@@ -124,9 +115,10 @@ def _fused_resize_normalize_pallas(batch, h_out: int, w_out: int,
         batch, *map(jnp.asarray, consts), h_out=h_out, w_out=w_out)
 
 
-@partial(jax.jit, static_argnames=("h_out", "w_out"))
+@partial(jax.jit, static_argnames=("h_out", "w_out", "c_out"))
 def _fused_resize_normalize_run(batch, ry_p, m, mean_t, inv_t,
-                                *, h_out: int, w_out: int):
+                                *, h_out: int, w_out: int,
+                                c_out: Optional[int] = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -146,7 +138,7 @@ def _fused_resize_normalize_run(batch, ry_p, m, mean_t, inv_t,
     # image: cast + resize + normalize never materialize full-size f32
     # intermediates, and the interpolation runs on the MXU.
     kin = w_in * c
-    kout = w_out * c
+    kout = w_out * (c_out if c_out is not None else c)
     h_out_p, kout_p = ry_p.shape[0], m.shape[1]
     h_in_p, kin_p = ry_p.shape[1], m.shape[0]
 
@@ -189,7 +181,8 @@ def _fused_resize_normalize_run(batch, ry_p, m, mean_t, inv_t,
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
     )(x2, ry_p, m, mean_t, inv_t)
-    return out[:, :h_out, :kout].reshape(b, h_out, w_out, c)
+    return out[:, :h_out, :kout].reshape(
+        b, h_out, w_out, c_out if c_out is not None else c)
 
 
 # one image must stage in VMEM (~16MB/core): input block + its f32 cast
@@ -198,17 +191,23 @@ def _fused_resize_normalize_run(batch, ry_p, m, mean_t, inv_t,
 PALLAS_IMAGE_VMEM_BUDGET = 8 * 1024 * 1024
 
 
+def _staged_bytes(h_in: int, w_in: int, c_in: int, h_out: int, w_out: int,
+                  c_out: int, itemsize: int) -> int:
+    """Per-grid-step VMEM estimate for the 2D affine kernel."""
+    kin, kout = _pad_up(w_in * c_in, 128), _pad_up(w_out * c_out, 128)
+    h_p, ho_p = _pad_up(h_in, 8), _pad_up(h_out, 8)
+    return (h_p * kin * (itemsize + 4)        # input block + f32 cast
+            + ho_p * h_p * 4                  # height weights ry_p
+            + ho_p * kin * 4                  # height-resized intermediate
+            + kin * kout * 4                  # interleaved width weights
+            + 2 * kout * 4                    # mean / inv-std row vectors
+            + ho_p * kout * 4)                # output block
+
+
 def _fits_vmem(in_shape, h_out: int, w_out: int, itemsize: int) -> bool:
     _, h, w, c = in_shape
-    kin, kout = _pad_up(w * c, 128), _pad_up(w_out * c, 128)
-    h_p, ho_p = _pad_up(h, 8), _pad_up(h_out, 8)
-    staged = (h_p * kin * (itemsize + 4)      # input block + f32 cast
-              + ho_p * h_p * 4                # height weights ry_p
-              + ho_p * kin * 4                # height-resized intermediate
-              + kin * kout * 4                # interleaved width weights
-              + 2 * kout * 4                  # mean / inv-std row vectors
-              + ho_p * kout * 4)              # output block
-    return staged <= PALLAS_IMAGE_VMEM_BUDGET
+    return _staged_bytes(h, w, c, h_out, w_out, c,
+                         itemsize) <= PALLAS_IMAGE_VMEM_BUDGET
 
 
 def fused_resize_normalize(batch: jnp.ndarray, h_out: int, w_out: int,
@@ -257,3 +256,195 @@ def fused_normalize_unroll(batch: jnp.ndarray,
 
         return hwc_to_chw_flat(normalize(batch, mean, std))
     return _fused_normalize_unroll_pallas(batch, mean, std)
+
+
+# ---------------------------------------------------------------------------
+# Fused affine image pipelines: every separable-linear ImageTransformer op
+# (crop / resize / flip / separable blur / color conversion) is a per-axis
+# matrix, so an entire op chain composes into the SAME two-matmul kernel —
+# out = (A_h @ X @ (A_w ⊗ C)) affine-tail — one HBM read and one write for
+# the whole pipeline (ImageTransformer.scala:282-400 runs these per-row on
+# OpenCV Mats; XLA runs them as separate fused loops; this is one pass).
+# ---------------------------------------------------------------------------
+
+# ops that change pixel values nonlinearly can't fold into the matmuls
+_COLOR_MATS = {
+    "bgr2rgb": np.eye(3)[:, ::-1],
+    "rgb2bgr": np.eye(3)[:, ::-1],
+    # BT.601 luma weights; BGR layout (ops.image._BGR2GRAY)
+    "bgr2gray": np.array([[0.114], [0.587], [0.299]]),
+    "rgb2gray": np.array([[0.299], [0.587], [0.114]]),
+    "gray2bgr": np.ones((1, 3)),
+    "gray2rgb": np.ones((1, 3)),
+}
+
+
+def _conv_same_matrix(n: int, k1d: np.ndarray) -> np.ndarray:
+    """[n, n] zero-padded SAME-convolution (Toeplitz) matrix matching
+    lax.conv SAME semantics: pad_low = (k-1)//2."""
+    k = len(k1d)
+    pad_low = (k - 1) // 2
+    t = np.zeros((n, n), np.float64)
+    for i in range(n):
+        for tap in range(k):
+            j = i + tap - pad_low
+            if 0 <= j < n:
+                t[i, j] += k1d[tap]
+    return t
+
+
+def build_affine_pipeline(stages, h_in: int, w_in: int, c_in: int):
+    """Compose an ImageTransformer op list into (A_h, A_w, C, mean, inv)
+    where out = (A_h @ X @ A_w^T per-axis, channels mixed by C) * inv - mean*inv.
+    Returns None when any op is not expressible (threshold, mid-chain
+    normalize) — the caller falls back to the XLA composition."""
+    from .image import gaussian_kernel
+
+    a_h = np.eye(h_in, dtype=np.float64)
+    a_w = np.eye(w_in, dtype=np.float64)
+    cmat = np.eye(c_in, dtype=np.float64)
+    h, w, c = h_in, w_in, c_in
+    mean = None
+    std = None
+    scale = 1.0
+    mixing = False  # a real interpolation/filter — pure permutation or
+    # selection chains (flip/crop/color swap) are faster as XLA views than
+    # as dense matmuls, so those decline fusion
+    for name, kw in stages or []:
+        if mean is not None:
+            return None  # ops after normalize: keep the XLA path
+        if name == "resize":
+            if kw.get("method", "linear") != "linear":
+                return None
+            nh, nw = int(kw["height"]), int(kw["width"])
+            mixing = mixing or nh != h or nw != w
+            a_h = _resize_weights_np(h, nh).astype(np.float64) @ a_h
+            a_w = _resize_weights_np(w, nw).astype(np.float64) @ a_w
+            h, w = nh, nw
+        elif name == "crop":
+            x0, y0 = int(kw["x"]), int(kw["y"])
+            cw, ch_ = int(kw["width"]), int(kw["height"])
+            a_h = a_h[y0:y0 + ch_]
+            a_w = a_w[x0:x0 + cw]
+            h, w = a_h.shape[0], a_w.shape[0]
+        elif name == "centerCrop":
+            ch_, cw = int(kw["height"]), int(kw["width"])
+            y0 = max((h - ch_) // 2, 0)
+            x0 = max((w - cw) // 2, 0)
+            a_h = a_h[y0:y0 + ch_]
+            a_w = a_w[x0:x0 + cw]
+            h, w = a_h.shape[0], a_w.shape[0]
+        elif name == "flip":
+            if kw.get("flipLeftRight", True):
+                a_w = a_w[::-1]
+            if kw.get("flipUpDown", False):
+                a_h = a_h[::-1]
+        elif name == "blur":
+            kh, kw_ = int(kw["height"]), int(kw["width"])
+            a_h = _conv_same_matrix(h, np.full(kh, 1.0 / kh)) @ a_h
+            a_w = _conv_same_matrix(w, np.full(kw_, 1.0 / kw_)) @ a_w
+            mixing = True
+        elif name == "gaussianKernel":
+            k2d = gaussian_kernel(int(kw["apertureSize"]), float(kw["sigma"]))
+            # gaussian_kernel is outer(g, g): recover the separable 1-D taps
+            g = np.sqrt(np.diag(k2d.astype(np.float64)))
+            a_h = _conv_same_matrix(h, g) @ a_h
+            a_w = _conv_same_matrix(w, g) @ a_w
+            mixing = True
+        elif name == "colorFormat":
+            m = _COLOR_MATS.get(kw["format"].lower())
+            if m is None or m.shape[0] != c:
+                return None
+            cmat = cmat @ m
+            c = m.shape[1]
+        elif name == "normalize":
+            scale = float(kw.get("scale", 1.0))
+            if scale == 0.0:
+                # degenerate: (u*0 - mean)/std is constant, which the
+                # (u - mean/scale)*(scale/std) folding can't express
+                return None
+            mean = np.broadcast_to(np.asarray(kw["mean"], np.float64), (c,))
+            std = np.broadcast_to(np.asarray(kw["std"], np.float64), (c,))
+        else:
+            return None  # threshold and anything unknown
+    if not mixing:
+        return None  # view-only chains: XLA composition wins
+    if mean is None:
+        mean = np.zeros(c)
+        std = np.ones(c)
+    # (u*scale - mean)/std == (u - mean/scale) * (scale/std); scale == 0
+    # declined fusion above
+    mean_eff = mean / scale
+    inv_eff = scale / std
+    return (a_h.astype(np.float32), a_w.astype(np.float32),
+            cmat.astype(np.float32), mean_eff.astype(np.float32),
+            inv_eff.astype(np.float32))
+
+
+def _affine_consts(a_h, a_w, cmat, mean_eff, inv_eff):
+    """Pad composed matrices to the (8, 128) tile grid and interleave the
+    width/channel matrices for the 2D kernel."""
+    h_out, h_in = a_h.shape
+    w_out, w_in = a_w.shape
+    c_in, c_out = cmat.shape
+    kin, kout = w_in * c_in, w_out * c_out
+    h_in_p, kin_p = _pad_up(h_in, 8), _pad_up(kin, 128)
+    h_out_p, kout_p = _pad_up(h_out, 8), _pad_up(kout, 128)
+    ry_p = np.zeros((h_out_p, h_in_p), np.float32)
+    ry_p[:h_out, :h_in] = a_h
+    m = np.zeros((kin_p, kout_p), np.float32)
+    for ci in range(c_in):
+        for co in range(c_out):
+            if cmat[ci, co] != 0.0:
+                m[ci:kin:c_in, co:kout:c_out] = a_w.T * cmat[ci, co]
+    mean_t = np.zeros((1, kout_p), np.float32)
+    inv_t = np.zeros((1, kout_p), np.float32)
+    for co in range(c_out):
+        mean_t[0, co:kout:c_out] = mean_eff[co]
+        inv_t[0, co:kout:c_out] = inv_eff[co]
+    return ry_p, m, mean_t, inv_t
+
+
+def affine_pipeline_fits_vmem(consts, itemsize: int = 4) -> bool:
+    a_h, a_w, cmat, _, _ = consts
+    return _staged_bytes(a_h.shape[1], a_w.shape[1], cmat.shape[0],
+                         a_h.shape[0], a_w.shape[0], cmat.shape[1],
+                         itemsize) <= PALLAS_IMAGE_VMEM_BUDGET
+
+
+def freeze_stages(stages) -> tuple:
+    """Hashable form of an ImageTransformer op list (lists -> tuples)."""
+
+    def fz(v):
+        if isinstance(v, np.ndarray):
+            return tuple(v.tolist())
+        if isinstance(v, (list, tuple)):
+            return tuple(fz(x) for x in v)
+        return v
+
+    return tuple((name, tuple(sorted((k, fz(v)) for k, v in kw.items())))
+                 for name, kw in (stages or []))
+
+
+@lru_cache(maxsize=16)
+def affine_plan(frozen_stages: tuple, h_in: int, w_in: int, c_in: int):
+    """Composed + padded + device-resident kernel constants for a frozen op
+    list and input shape — or None when the chain isn't fusable (nonlinear
+    op, view-only chain, VMEM overflow).  Cached so repeated batches reuse
+    one host composition and one device upload."""
+    consts = build_affine_pipeline(
+        [(name, dict(kw)) for name, kw in frozen_stages], h_in, w_in, c_in)
+    if consts is None or not affine_pipeline_fits_vmem(consts):
+        return None
+    a_h, a_w, cmat, mean_eff, inv_eff = consts
+    padded = tuple(jnp.asarray(p)
+                   for p in _affine_consts(a_h, a_w, cmat, mean_eff, inv_eff))
+    return padded, (a_h.shape[0], a_w.shape[0], cmat.shape[1])
+
+
+def fused_affine_apply(batch: jnp.ndarray, plan) -> jnp.ndarray:
+    """Run a cached affine plan (from affine_plan) as one VMEM-resident
+    kernel pass over [B,H,W,C]."""
+    padded, (h_out, w_out, c_out) = plan
+    return _fused_resize_normalize_run(
+        batch, *padded, h_out=h_out, w_out=w_out, c_out=c_out)

@@ -1,0 +1,155 @@
+"""Fused affine image pipelines: an entire ImageTransformer op chain
+(crop/resize/flip/blur/color/normalize) composed into one two-matmul kernel
+must match the XLA op-by-op composition exactly.
+
+Reference: ImageTransformer.scala:282-400 runs the same op list per-row on
+OpenCV Mats; here both paths are batched device programs and the fused one
+is a single HBM pass.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.io.image import array_to_image_row
+from mmlspark_tpu.ops.image_stages import ImageTransformer
+from mmlspark_tpu.ops.pallas_kernels import build_affine_pipeline
+
+
+def _table(rng, n=3, h=24, w=20, c=3):
+    rows = [array_to_image_row(
+        rng.integers(0, 255, (h, w, c) if c > 1 else (h, w)).astype(np.uint8))
+        for _ in range(n)]
+    return Table({"image": rows})
+
+
+def _build(stages):
+    t = ImageTransformer(output_col="out")
+    for name, kw in stages:
+        t._add(name, **kw)
+    return t
+
+
+PIPELINES = [
+    pytest.param([("resize", dict(height=16, width=12)),
+                  ("normalize", dict(mean=[1.0, 2.0, 3.0],
+                                     std=[4.0, 5.0, 6.0]))], id="resize-norm"),
+    pytest.param([("crop", dict(x=2, y=3, width=14, height=16)),
+                  ("resize", dict(height=10, width=10))], id="crop-resize"),
+    pytest.param([("centerCrop", dict(height=16, width=16)),
+                  ("flip", dict(flipLeftRight=True, flipUpDown=True))],
+                 id="centercrop-flip"),
+    pytest.param([("blur", dict(height=3, width=2)),
+                  ("resize", dict(height=12, width=12))], id="boxblur-resize"),
+    pytest.param([("gaussianKernel", dict(apertureSize=5, sigma=1.2)),
+                  ("flip", dict(flipLeftRight=True))], id="gauss-flip"),
+    pytest.param([("colorFormat", dict(format="bgr2rgb")),
+                  ("resize", dict(height=8, width=8)),
+                  ("normalize", dict(mean=[0.5], std=[2.0], scale=0.5))],
+                 id="color-resize-norm-scale"),
+    pytest.param([("colorFormat", dict(format="bgr2gray")),
+                  ("resize", dict(height=12, width=10))], id="gray-resize"),
+]
+
+
+@pytest.mark.parametrize("stages", PIPELINES)
+def test_fused_matches_xla(stages, rng):
+    t = _table(rng)
+    fused = _build(stages)
+    fused.set(fuse=True)
+    plain = _build(stages)
+    plain.set(fuse=False)
+    out_f = fused.transform(t)["out"]
+    out_p = plain.transform(t)["out"]
+    for a, b in zip(out_f, out_p):
+        # uint8 rows may differ by one LSB where the float results straddle
+        # a rounding threshold; float outputs must agree to fp tolerance
+        uint8_row = isinstance(a, dict)
+        fa = a["data"] if uint8_row else a
+        fb = b["data"] if uint8_row else b
+        np.testing.assert_allclose(np.asarray(fa, np.float32),
+                                   np.asarray(fb, np.float32),
+                                   rtol=1e-4, atol=1.0 if uint8_row else 1e-2)
+
+
+def test_gray_input_upconvert(rng):
+    t = _table(rng, c=1)
+    stages = [("colorFormat", dict(format="gray2bgr")),
+              ("resize", dict(height=10, width=10))]
+    fused = _build(stages); fused.set(fuse=True)
+    plain = _build(stages); plain.set(fuse=False)
+    out_f = fused.transform(t)["out"]
+    out_p = plain.transform(t)["out"]
+    for a, b in zip(out_f, out_p):
+        np.testing.assert_allclose(
+            np.asarray(a["data"], np.float32),
+            np.asarray(b["data"], np.float32), rtol=1e-4, atol=1e-2)
+
+
+def test_nonlinear_ops_refuse_fusion():
+    assert build_affine_pipeline(
+        [("threshold", dict(threshold=10, maxVal=255)),
+         ("resize", dict(height=4, width=4))], 8, 8, 3) is None
+    assert build_affine_pipeline(
+        [("normalize", dict(mean=[0.0], std=[1.0])),
+         ("resize", dict(height=4, width=4))], 8, 8, 3) is None
+    # unknown method
+    assert build_affine_pipeline(
+        [("resize", dict(height=4, width=4, method="nearest"))], 8, 8, 3) is None
+
+
+def test_ndarray_params_and_zero_scale(rng):
+    # ndarray mean/std must hash into the plan cache; scale=0 must decline
+    from mmlspark_tpu.ops.pallas_kernels import affine_plan, freeze_stages
+
+    stages = [("resize", dict(height=8, width=8)),
+              ("normalize", dict(mean=np.array([0.4, 0.5, 0.6]),
+                                 std=np.array([0.2, 0.2, 0.2])))]
+    plan = affine_plan(freeze_stages(stages), 16, 12, 3)
+    assert plan is not None
+    assert build_affine_pipeline(
+        [("resize", dict(height=8, width=8)),
+         ("normalize", dict(mean=[10.0], std=[2.0], scale=0.0))],
+        16, 12, 3) is None
+
+
+def test_view_only_chains_decline_fusion():
+    # flips/crops/color swaps are faster as XLA views than dense matmuls
+    assert build_affine_pipeline(
+        [("flip", dict(flipLeftRight=True))], 8, 8, 3) is None
+    assert build_affine_pipeline(
+        [("crop", dict(x=1, y=1, width=4, height=4)),
+         ("colorFormat", dict(format="bgr2rgb"))], 8, 8, 3) is None
+    # but any real interpolation/filter makes the chain worth fusing
+    assert build_affine_pipeline(
+        [("flip", dict(flipLeftRight=True)),
+         ("resize", dict(height=4, width=4))], 8, 8, 3) is not None
+
+
+def test_param_mutation_invalidates_pipeline_cache(rng):
+    t = _table(rng)
+    stage = ImageTransformer(output_col="out", fuse=False)
+    stage.resize(10, 10)
+    out1 = stage.transform(t)["out"]
+    assert out1[0]["height"] == 10
+    stage.center_crop(6, 6)  # mutate params AFTER a transform
+    out2 = stage.transform(t)["out"]
+    assert out2[0]["height"] == 6, "stale jitted pipeline served after set()"
+
+
+def test_fused_path_actually_taken(rng, monkeypatch):
+    t = _table(rng)
+    stage = _build([("resize", dict(height=8, width=8))])
+    stage.set(fuse=True)
+    called = {}
+    import mmlspark_tpu.ops.image_stages as mod
+    from mmlspark_tpu.ops import pallas_kernels as pk
+
+    orig = pk.fused_affine_apply
+
+    def spy(batch, consts):
+        called["yes"] = True
+        return orig(batch, consts)
+
+    monkeypatch.setattr(pk, "fused_affine_apply", spy)
+    stage.transform(t)
+    assert called.get("yes"), "fuse=True must route through the fused kernel"
