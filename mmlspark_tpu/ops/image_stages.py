@@ -211,8 +211,10 @@ class ImageTransformer(_BatchedImageStage):
         return run
 
     def _fuse_wanted(self) -> bool:
+        from .pallas_kernels import pallas_available
+
         f = self.get_or_default("fuse")
-        if f is False:
+        if f is False or not pallas_available():
             return False
         if f is None:  # auto: interpret-mode Pallas on CPU is slower than XLA
             return jax.default_backend() == "tpu"

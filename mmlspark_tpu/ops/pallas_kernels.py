@@ -267,16 +267,21 @@ def fused_normalize_unroll(batch: jnp.ndarray,
 # OpenCV Mats; XLA runs them as separate fused loops; this is one pass).
 # ---------------------------------------------------------------------------
 
-# ops that change pixel values nonlinearly can't fold into the matmuls
-_COLOR_MATS = {
-    "bgr2rgb": np.eye(3)[:, ::-1],
-    "rgb2bgr": np.eye(3)[:, ::-1],
-    # BT.601 luma weights; BGR layout (ops.image._BGR2GRAY)
-    "bgr2gray": np.array([[0.114], [0.587], [0.299]]),
-    "rgb2gray": np.array([[0.299], [0.587], [0.114]]),
-    "gray2bgr": np.ones((1, 3)),
-    "gray2rgb": np.ones((1, 3)),
-}
+def _color_mats():
+    """Channel-mixing matrices matching ops.image.color_convert exactly
+    (gray weights come from the same _BGR2GRAY constant, so the fused and
+    XLA paths can never diverge)."""
+    from .image import _BGR2GRAY
+
+    gray_bgr = np.asarray(_BGR2GRAY, np.float64).reshape(3, 1)
+    return {
+        "bgr2rgb": np.eye(3)[:, ::-1],
+        "rgb2bgr": np.eye(3)[:, ::-1],
+        "bgr2gray": gray_bgr,
+        "rgb2gray": gray_bgr[::-1],
+        "gray2bgr": np.ones((1, 3)),
+        "gray2rgb": np.ones((1, 3)),
+    }
 
 
 def _conv_same_matrix(n: int, k1d: np.ndarray) -> np.ndarray:
@@ -352,7 +357,7 @@ def build_affine_pipeline(stages, h_in: int, w_in: int, c_in: int):
             a_w = _conv_same_matrix(w, g) @ a_w
             mixing = True
         elif name == "colorFormat":
-            m = _COLOR_MATS.get(kw["format"].lower())
+            m = _color_mats().get(kw["format"].lower())
             if m is None or m.shape[0] != c:
                 return None
             cmat = cmat @ m

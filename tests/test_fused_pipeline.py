@@ -141,7 +141,6 @@ def test_fused_path_actually_taken(rng, monkeypatch):
     stage = _build([("resize", dict(height=8, width=8))])
     stage.set(fuse=True)
     called = {}
-    import mmlspark_tpu.ops.image_stages as mod
     from mmlspark_tpu.ops import pallas_kernels as pk
 
     orig = pk.fused_affine_apply

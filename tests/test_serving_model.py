@@ -1,0 +1,73 @@
+"""Serving a REAL device model end-to-end: ImageFeaturizer behind
+ServingServer's continuous-batching loop — the SparkServing continuous-
+batched model endpoint configuration (BASELINE.json config 5;
+docs/mmlspark-serving.md pipeline-behind-HTTP examples)."""
+import base64
+import io
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mmlspark_tpu import LambdaTransformer, Table
+from mmlspark_tpu.core.pipeline import Pipeline
+from mmlspark_tpu.models.bundle import FlaxBundle
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu.serving import ServingServer
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    import jax.numpy as jnp
+
+    return FlaxBundle(
+        "resnet18", {"num_classes": 10, "dtype": jnp.float32},
+        input_shape=(32, 32, 3), seed=0,
+    )
+
+
+def _jpeg_b64(rng) -> str:
+    arr = rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def _post(url: str, payload: dict) -> dict:
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_featurizer_served_continuous(bundle, rng):
+    # decode b64 -> bytes column, featurize, reply with the feature vector
+    stages = Pipeline(stages=[
+        LambdaTransformer(fn=lambda t: t.with_column(
+            "image", [base64.b64decode(v) for v in t["image_b64"]])),
+        ImageFeaturizer(bundle=bundle, input_col="image",
+                        output_col="features", batch_size=4),
+        LambdaTransformer(fn=lambda t: t.with_column(
+            "reply", [list(map(float, row[:4])) for row in t["features"]])),
+    ])
+    # all-transformer pipeline: fit is a pass-through yielding the model
+    pipeline = stages.fit(Table({"image_b64": [_jpeg_b64(rng)]}))
+    srv = ServingServer(model=pipeline, reply_col="reply",
+                        name="feat", path="/featurize", max_batch=8)
+    info = srv.start()
+    try:
+        url = f"http://{info.host}:{info.port}/featurize"
+        payloads = [{"image_b64": _jpeg_b64(rng)} for _ in range(6)]
+        replies = [_post(url, p) for p in payloads]
+        assert all(len(r["reply"]) == 4 for r in replies)
+        # server reply must equal a direct transform of the same bytes
+        direct = pipeline.transform(
+            Table({"image_b64": [p["image_b64"] for p in payloads]}))
+        for got, want in zip(replies, direct["reply"]):
+            np.testing.assert_allclose(got["reply"], want, rtol=1e-4,
+                                       atol=1e-4)
+    finally:
+        srv.stop()
