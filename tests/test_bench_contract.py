@@ -1,0 +1,121 @@
+"""bench.py parent-flow contract: the driver consumes exactly one JSON
+line per run, and the round artifact must survive every failure mode —
+probe failure and infra death degrade to the cached last-good record
+(marked stale), while deterministic child failures surface as value:null
+so regressions can't hide behind "stale infra"."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def _bench_module():
+    # load once per module: exec'ing bench.py inserts the repo root into
+    # sys.path, so re-loading per test would leak duplicate entries
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def bench(_bench_module, tmp_path, monkeypatch):
+    mod = _bench_module
+    monkeypatch.setattr(mod, "LASTGOOD_FILE", str(tmp_path / "lastgood.json"))
+    monkeypatch.setattr(mod, "BASELINE_FILE", str(tmp_path / "baseline.json"))
+    (tmp_path / "baseline.json").write_text(
+        json.dumps({"cpu_images_per_sec": 10.0}))
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    return mod
+
+
+def _one_json_line(capsys) -> dict:
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"must print exactly one JSON line, got {out}"
+    return json.loads(out[0])
+
+
+class _Proc:
+    def __init__(self, rc=0, stdout="", stderr=""):
+        self.returncode = rc
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def test_probe_down_no_cache_reports_null(bench, capsys, monkeypatch):
+    monkeypatch.setattr(bench, "_probe_backend", lambda: False)
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] is None and "unavailable" in rec["error"]
+
+
+def test_probe_down_with_cache_reports_stale(bench, capsys, monkeypatch):
+    with open(bench.LASTGOOD_FILE, "w") as f:
+        json.dump({"metric": "m", "value": 123.0}, f)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: False)
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] == 123.0 and rec["stale"] is True
+
+
+def test_good_child_composes_record_and_caches(bench, capsys, monkeypatch):
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+    child = json.dumps({
+        "res": {"value": 200.0, "forward_ips": 8000.0, "mfu": 0.4,
+                "platform": "tpu", "device_kind": "TPU v5 lite"},
+        "train": {"train_samples_per_sec": 5000.0}})
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: _Proc(0, stdout=child + "\n"))
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] == 200.0
+    assert rec["vs_baseline"] == 20.0
+    assert rec["cifar10_train_samples_per_sec"] == 5000.0
+    with open(bench.LASTGOOD_FILE) as f:
+        assert json.load(f)["value"] == 200.0
+
+
+def test_child_timeout_reports_stale(bench, capsys, monkeypatch):
+    with open(bench.LASTGOOD_FILE, "w") as f:
+        json.dump({"metric": "m", "value": 99.0}, f)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+
+    def boom(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="bench", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", boom)
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] == 99.0 and rec["stale"] is True
+
+
+def test_child_infra_death_reports_stale(bench, capsys, monkeypatch):
+    with open(bench.LASTGOOD_FILE, "w") as f:
+        json.dump({"metric": "m", "value": 88.0}, f)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _Proc(1, stderr="UNAVAILABLE: tunnel lost"))
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] == 88.0 and rec["stale"] is True
+
+
+def test_child_code_bug_surfaces_null_not_stale(bench, capsys, monkeypatch):
+    with open(bench.LASTGOOD_FILE, "w") as f:
+        json.dump({"metric": "m", "value": 77.0}, f)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _Proc(1, stderr="AssertionError: shape mismatch"))
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] is None
+    assert "AssertionError" in rec["error"]
