@@ -85,9 +85,12 @@ class FlaxBundle(ModelBundle):
         if variables is None:
             if self.input_shape is None:
                 raise ValueError("need input_shape to initialize variables")
+            # token models (nn.Embed inputs) declare input_dtype=int32 on
+            # the module; image/feature models default to float32
+            in_dtype = getattr(self.module, "input_dtype", jnp.float32)
             variables = self.module.init(
                 {"params": jax.random.PRNGKey(seed)},
-                jnp.zeros((1, *self.input_shape), jnp.float32),
+                jnp.zeros((1, *self.input_shape), in_dtype),
             )
         self._variables = _to_numpy(variables)
         if layer_names is None:
@@ -159,6 +162,9 @@ def _register_defaults():
         register_builder(name, getattr(R, name))
     for name in ("alexnet", "vgg11", "vgg16", "convnet_cifar"):
         register_builder(name, getattr(C, name))
+    from .transformer import transformer_lm
+
+    register_builder("transformer_lm", transformer_lm)
 
 
 _register_defaults()

@@ -108,14 +108,18 @@ _EXEC_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
 _EXEC_CACHE_MAX = 8
 
 
-def _gather_input(col: np.ndarray, input_shape) -> np.ndarray:
-    """Rows (vectors / arrays / scalars) -> [B, ...] float32, reshaping flat
-    CHW vectors to the bundle's input shape when given (coerceDFAndFeedDict,
-    CNTKModel.scala:450-466)."""
+_FEED_DTYPES = {"float32": np.float32, "uint8": np.uint8, "int32": np.int32}
+
+
+def _gather_input(col: np.ndarray, input_shape,
+                  dtype=np.float32) -> np.ndarray:
+    """Rows (vectors / arrays / scalars) -> [B, ...] of the feed dtype,
+    reshaping flat CHW vectors to the bundle's input shape when given
+    (coerceDFAndFeedDict, CNTKModel.scala:450-466)."""
     if col.dtype != object:
-        batch = np.asarray(col, dtype=np.float32)
+        batch = np.asarray(col, dtype=dtype)
     else:
-        batch = np.stack([np.asarray(v, dtype=np.float32) for v in col])
+        batch = np.stack([np.asarray(v, dtype=dtype) for v in col])
     if input_shape is not None and batch.shape[1:] != tuple(input_shape):
         if int(np.prod(batch.shape[1:])) == int(np.prod(input_shape)):
             # flat CHW vector -> HWC image (UnrollImage layout, c*h*w)
@@ -144,8 +148,8 @@ class TPUModel(Transformer):
     group_by_shape = Param(
         "group ragged input rows by shape, one XLA program per shape group",
         default=False, converter=TypeConverters.to_bool)
-    feed_dtype = Param("host->device transfer dtype (float32|uint8)",
-                       default="float32")
+    feed_dtype = Param("host->device transfer dtype (float32|uint8|int32 — "
+                       "int32 for token-id models)", default="float32")
 
     def __init__(self, bundle: Optional[ModelBundle] = None, **kw):
         super().__init__(**kw)
@@ -204,7 +208,7 @@ class TPUModel(Transformer):
         """Feed same-shape rows through the executor; returns per-row outputs."""
         dp = mesh.shape["data"]
         bs, pad_mult = self.chunk_sizes(len(rows), dp)
-        dtype = np.uint8 if self.feed_dtype == "uint8" else np.float32
+        dtype = _FEED_DTYPES[self.feed_dtype]
 
         def prep():
             for start in range(0, len(rows), bs):
@@ -276,7 +280,9 @@ class TPUModel(Transformer):
                     cells[i] = y
             result = np.stack(cells) if n else np.zeros((0,))
         else:
-            batch_np = _gather_input(col, bundle.input_shape) if n else None
+            batch_np = _gather_input(
+                col, bundle.input_shape,
+                _FEED_DTYPES[self.feed_dtype]) if n else None
             rows = list(batch_np) if n else []
             out_rows = self._run_chunks(rows, jitted, dev_vars, mesh)
             result = np.stack(out_rows) if out_rows else np.zeros((0,))

@@ -1,0 +1,105 @@
+"""TransformerLM: a decoder-only language model with pluggable attention —
+dense causal on one chip, exact ring attention over the mesh 'seq' axis for
+long sequences.
+
+Beyond-reference capability (the reference's longest-sequence handling is
+the CNTK BiLSTM notebook, SURVEY §2.10 last row): sequence parallelism is
+first-class here, so the same module trains/scans on contexts far longer
+than one chip's HBM by sharding S over the mesh.  The attention
+implementation is a constructor argument, not a fork of the model — the
+parameters and numerics are identical either way (ring attention is exact,
+parallel/ring_attention.py), which the tests assert.
+
+TPU-first: bfloat16 compute / float32 params, pre-LN blocks (stable in low
+precision), all shapes static under jit.  Named taps follow the zoo
+contract: taps[layer_names[1]] ("pool", mean-pooled final hidden state) is
+the penultimate feature for TPUModel / TrainClassifier composition.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TransformerLM", "transformer_lm"]
+
+
+class _Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int
+    dtype: Any
+    attn_fn: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, e = x.shape
+        h = self.num_heads
+        d = e // h
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * e, use_bias=False, dtype=self.dtype,
+                       name="qkv")(y)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
+        # attention accumulates in f32 (online softmax) regardless of dtype
+        a = self.attn_fn(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32))
+        a = a.astype(self.dtype).reshape(b, s, e)
+        x = x + nn.Dense(e, use_bias=False, dtype=self.dtype,
+                         name="proj")(a)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        return x + nn.Dense(e, dtype=self.dtype, name="mlp_out")(y)
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM over int32 token ids [B, S]."""
+
+    vocab_size: int = 1024
+    embed_dim: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    max_len: int = 2048
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    # None -> dense causal attention; or any (q, k, v) -> out with
+    # (B, S, H, D) shapes, e.g. partial(ring_attention, mesh=m, causal=True)
+    attn_fn: Optional[Callable] = None
+    layer_names = ["logits", "pool", "hidden", "embed"]
+    input_dtype = jnp.int32  # token ids (FlaxBundle auto-init dummy dtype)
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        from ..parallel.ring_attention import full_attention
+
+        attn = self.attn_fn or (
+            lambda q, k, v: full_attention(q, k, v, causal=True))
+        taps: Dict[str, jnp.ndarray] = {}
+        b, s = tokens.shape
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
+                     name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(s))
+        x = x + pos[None]
+        taps["embed"] = x
+        for i in range(self.num_layers):
+            x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,
+                       name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        taps["hidden"] = x
+        taps["pool"] = jnp.mean(x, axis=1).astype(jnp.float32)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          name="head")(x).astype(jnp.float32)
+        taps["logits"] = logits
+        return logits, taps
+
+
+def transformer_lm(vocab_size=1024, embed_dim=128, num_layers=2, num_heads=4,
+                   max_len=2048, dtype=jnp.bfloat16, attn_fn=None,
+                   num_classes=None):
+    """Builder (zoo registry).  `num_classes` is accepted and ignored so the
+    generic builder call sites (get_builder(name)(num_classes=...)) work."""
+    return TransformerLM(vocab_size=vocab_size, embed_dim=embed_dim,
+                         num_layers=num_layers, num_heads=num_heads,
+                         max_len=max_len, dtype=dtype, attn_fn=attn_fn)
