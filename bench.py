@@ -225,10 +225,32 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     return out
 
 
+INFRA_SENTINEL = "BENCH_INFRA_ERROR"
+
+
+def _is_infra_error(e: BaseException) -> bool:
+    """Backend/tunnel failures, NOT app-code bugs: the jax runtime raises
+    XlaRuntimeError carrying a gRPC status; generic ConnectionError etc.
+    from application code must not match."""
+    if type(e).__name__ == "XlaRuntimeError":
+        return True
+    msg = str(e)
+    return any(m in msg for m in (
+        "DEADLINE_EXCEEDED", "UNAVAILABLE", "remote_compile",
+        "Unable to initialize backend"))
+
+
 def _child_measure():
     """Runs in a watchdogged subprocess: the full chip measurement, one
-    JSON line {res, train} on stdout."""
-    res = _measure(N_E2E, BATCH, ITERS)
+    JSON line {res, train} on stdout.  Infra failures (tunnel death,
+    backend init) are tagged with a stderr sentinel so the parent can
+    distinguish them from deterministic code regressions."""
+    try:
+        res = _measure(N_E2E, BATCH, ITERS)
+    except Exception as e:
+        if _is_infra_error(e):
+            sys.stderr.write(f"\n{INFRA_SENTINEL}\n")
+        raise
     try:
         train = _measure_train()
     except Exception as e:  # noqa: BLE001 — train bench must not kill the record
@@ -295,11 +317,14 @@ def main():
         return
     if proc.returncode != 0 or not proc.stdout.strip():
         tail = (proc.stderr or "")[-400:]
-        infra_markers = ("DEADLINE", "UNAVAILABLE", "unavailable",
-                         "remote_compile", "Socket", "socket",
-                         "Connection", "connection", "TimeoutError")
-        if any(m in tail for m in infra_markers):
-            _report_stale(f"measurement died on infra error; last good")
+        # the child tags infra errors explicitly (see _child_measure); a
+        # deterministic code regression — even one whose traceback mentions
+        # "Connection" or "TimeoutError" — surfaces as value:null.  A child
+        # killed by a signal (returncode < 0: libtpu/gRPC C++ abort on
+        # tunnel death) never reaches Python exception handling, so signal
+        # deaths also count as infra.
+        if INFRA_SENTINEL in (proc.stderr or "") or proc.returncode < 0:
+            _report_stale("measurement died on infra error; last good")
         else:
             print(json.dumps({
                 "metric": "resnet50_imagefeaturizer_images_per_sec_per_chip",

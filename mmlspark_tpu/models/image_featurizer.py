@@ -211,7 +211,7 @@ class ImageFeaturizer(Transformer):
             table = table.filter(keep)
             results = [r for i, r in enumerate(results) if i not in bad]
         out = (np.stack(results) if results
-               else np.zeros((0, 0), np.float32))
+               else np.zeros((0,), np.float32))  # same empty shape as TPUModel
         return table.with_column(self.output_col, out)
 
     def transform_schema(self, columns: List[str]) -> List[str]:

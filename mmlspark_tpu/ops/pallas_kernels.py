@@ -196,7 +196,11 @@ def _staged_bytes(h_in: int, w_in: int, c_in: int, h_out: int, w_out: int,
     """Per-grid-step VMEM estimate for the 2D affine kernel."""
     kin, kout = _pad_up(w_in * c_in, 128), _pad_up(w_out * c_out, 128)
     h_p, ho_p = _pad_up(h_in, 8), _pad_up(h_out, 8)
-    return (h_p * kin * (itemsize + 4)        # input block + f32 cast
+    # uint8 inputs widen through an int32 intermediate before the f32 cast
+    # (Mosaic has no direct u8->f32), staging an extra 4 bytes/elem
+    widen = h_p * kin * 4 if itemsize == 1 else 0
+    return (widen
+            + h_p * kin * (itemsize + 4)      # input block + f32 cast
             + ho_p * h_p * 4                  # height weights ry_p
             + ho_p * kin * 4                  # height-resized intermediate
             + kin * kout * 4                  # interleaved width weights

@@ -102,10 +102,40 @@ def test_child_infra_death_reports_stale(bench, capsys, monkeypatch):
     monkeypatch.setattr(bench, "_probe_backend", lambda: True)
     monkeypatch.setattr(
         bench.subprocess, "run",
-        lambda *a, **k: _Proc(1, stderr="UNAVAILABLE: tunnel lost"))
+        lambda *a, **k: _Proc(
+            1, stderr=f"UNAVAILABLE: tunnel lost\n{bench.INFRA_SENTINEL}\n"))
     bench.main()
     rec = _one_json_line(capsys)
     assert rec["value"] == 88.0 and rec["stale"] is True
+
+
+def test_signal_death_reports_stale(bench, capsys, monkeypatch):
+    """A child killed at the C++ level (SIGABRT from libtpu on tunnel
+    death) has no Python exception to tag — signal death is infra."""
+    with open(bench.LASTGOOD_FILE, "w") as f:
+        json.dump({"metric": "m", "value": 66.0}, f)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _Proc(-6, stderr="UNAVAILABLE: Socket closed"))
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] == 66.0 and rec["stale"] is True
+
+
+def test_untagged_connectionerror_is_a_code_bug(bench, capsys, monkeypatch):
+    """A traceback that merely MENTIONS Connection/TimeoutError (app code,
+    not the backend) must surface as value:null, not hide behind stale."""
+    with open(bench.LASTGOOD_FILE, "w") as f:
+        json.dump({"metric": "m", "value": 88.0}, f)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _Proc(
+            1, stderr="ConnectionError: app bug in featurizer retry loop"))
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] is None
 
 
 def test_child_code_bug_surfaces_null_not_stale(bench, capsys, monkeypatch):
