@@ -320,10 +320,16 @@ def main():
         # the child tags infra errors explicitly (see _child_measure); a
         # deterministic code regression — even one whose traceback mentions
         # "Connection" or "TimeoutError" — surfaces as value:null.  A child
-        # killed by a signal (returncode < 0: libtpu/gRPC C++ abort on
-        # tunnel death) never reaches Python exception handling, so signal
-        # deaths also count as infra.
-        if INFRA_SENTINEL in (proc.stderr or "") or proc.returncode < 0:
+        # killed by a signal (libtpu/gRPC C++ abort on tunnel death) never
+        # reaches Python exception handling, so signal deaths ALSO count as
+        # infra — but only with backend markers in stderr (gRPC/absl logs),
+        # so an app-code segfault (e.g. the native JPEG decoder) still
+        # surfaces as value:null instead of hiding behind stale.
+        signal_infra = proc.returncode < 0 and any(
+            m in (proc.stderr or "") for m in (
+                "DEADLINE_EXCEEDED", "UNAVAILABLE", "remote_compile",
+                "libtpu", "grpc"))
+        if INFRA_SENTINEL in (proc.stderr or "") or signal_infra:
             _report_stale("measurement died on infra error; last good")
         else:
             print(json.dumps({

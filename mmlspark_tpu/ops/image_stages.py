@@ -226,7 +226,8 @@ class ImageTransformer(_BatchedImageStage):
                 affine_plan, freeze_stages, fused_affine_apply)
 
             plan = affine_plan(freeze_stages(self.stages),
-                               *batch.shape[1:])
+                               *batch.shape[1:],
+                               itemsize=batch.dtype.itemsize)
             if plan is not None:
                 return np.asarray(fused_affine_apply(jnp.asarray(batch),
                                                      plan))

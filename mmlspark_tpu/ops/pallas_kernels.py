@@ -436,14 +436,17 @@ def freeze_stages(stages) -> tuple:
 
 
 @lru_cache(maxsize=16)
-def affine_plan(frozen_stages: tuple, h_in: int, w_in: int, c_in: int):
+def affine_plan(frozen_stages: tuple, h_in: int, w_in: int, c_in: int,
+                itemsize: int = 4):
     """Composed + padded + device-resident kernel constants for a frozen op
     list and input shape — or None when the chain isn't fusable (nonlinear
-    op, view-only chain, VMEM overflow).  Cached so repeated batches reuse
-    one host composition and one device upload."""
+    op, view-only chain, VMEM overflow).  `itemsize` is the BATCH dtype's
+    (uint8 stages an extra int32 widen in VMEM — see _staged_bytes).
+    Cached so repeated batches reuse one host composition and one device
+    upload."""
     consts = build_affine_pipeline(
         [(name, dict(kw)) for name, kw in frozen_stages], h_in, w_in, c_in)
-    if consts is None or not affine_pipeline_fits_vmem(consts):
+    if consts is None or not affine_pipeline_fits_vmem(consts, itemsize):
         return None
     a_h, a_w, cmat, mean_eff, inv_eff = consts
     padded = tuple(jnp.asarray(p)

@@ -111,7 +111,8 @@ def test_child_infra_death_reports_stale(bench, capsys, monkeypatch):
 
 def test_signal_death_reports_stale(bench, capsys, monkeypatch):
     """A child killed at the C++ level (SIGABRT from libtpu on tunnel
-    death) has no Python exception to tag — signal death is infra."""
+    death) has no Python exception to tag — signal death with backend
+    markers in stderr is infra."""
     with open(bench.LASTGOOD_FILE, "w") as f:
         json.dump({"metric": "m", "value": 66.0}, f)
     monkeypatch.setattr(bench, "_probe_backend", lambda: True)
@@ -121,6 +122,20 @@ def test_signal_death_reports_stale(bench, capsys, monkeypatch):
     bench.main()
     rec = _one_json_line(capsys)
     assert rec["value"] == 66.0 and rec["stale"] is True
+
+
+def test_app_code_segfault_surfaces_null(bench, capsys, monkeypatch):
+    """A signal death WITHOUT backend markers (segfault in app native
+    code, e.g. the JPEG decoder) is a code regression, not infra."""
+    with open(bench.LASTGOOD_FILE, "w") as f:
+        json.dump({"metric": "m", "value": 66.0}, f)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _Proc(-11, stderr="Segmentation fault"))
+    bench.main()
+    rec = _one_json_line(capsys)
+    assert rec["value"] is None
 
 
 def test_untagged_connectionerror_is_a_code_bug(bench, capsys, monkeypatch):

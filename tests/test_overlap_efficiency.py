@@ -1,0 +1,85 @@
+"""Feed-architecture overlap efficiency, measured off-tunnel.
+
+The chip benchmark's e2e-vs-forward gap is dominated by the axon tunnel's
+host->device bandwidth, so it can't tell whether the async double-buffered
+feed (TPUModel.run_chunk_iter; the Batchers.scala:12-65 +
+CNTKModel.scala:88-140 overlap pattern) is itself efficient.  This test
+proves it independent of the tunnel: on the local CPU backend, the FULL
+ImageFeaturizer path — JPEG decode on the prefetch thread, chunk assembly,
+sharded device_put, forward, async fetch — must reach >=70% of the
+forward-only throughput of the SAME compiled program on device-resident
+input.  That was round 1's acceptance bar for the feed design.
+"""
+import io
+import time
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.bundle import FlaxBundle
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu import native
+from mmlspark_tpu.parallel.mesh import batch_sharding
+
+N = 96
+SRC = 128          # source JPEG side; resized on device to the model's 112
+BATCH = 32
+MIN_RATIO = 0.70
+
+
+@pytest.mark.skipif(not native.jpeg_available(),
+                    reason="needs the native JPEG decoder (streaming path)")
+def test_e2e_feed_at_least_70pct_of_forward_only():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    blobs = []
+    for _ in range(N):
+        arr = rng.integers(0, 256, (SRC, SRC, 3), np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=85)
+        blobs.append(buf.getvalue())
+    table = Table({"image": blobs})
+
+    # forward cost must dominate decode for the ratio to measure the FEED,
+    # not the codec: resnet18 @ 112^2 is ~15ms/img on XLA-CPU vs ~1ms decode
+    bundle = FlaxBundle("resnet18", {"num_classes": 10, "dtype": jnp.float32},
+                        input_shape=(112, 112, 3), seed=0)
+    feat = ImageFeaturizer(bundle=bundle, input_col="image",
+                           output_col="features", batch_size=BATCH)
+
+    # forward-only upper bound: the SAME cached executor program the e2e
+    # path runs (preprocess fused), on an already-staged sharded batch
+    model = feat._model_for(bundle, "image")
+    dev_vars, jitted, mesh = model._executor(bundle, model._fetch_name(bundle))
+    bs, _ = model.chunk_sizes(N, mesh.shape["data"])
+    xs = rng.integers(0, 256, (bs, SRC, SRC, 3), np.uint8)
+    x = jax.device_put(xs, batch_sharding(mesh, xs.ndim))
+    jax.block_until_ready(jitted(dev_vars, x))  # compile once
+    fwd_dt = None
+    for _ in range(3):  # best-of-3: the 1-core host is noisy
+        t0 = time.perf_counter()
+        for _ in range(3):
+            y = jitted(dev_vars, x)
+        jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        fwd_dt = dt if fwd_dt is None else min(fwd_dt, dt)
+    fwd_ips = 3 * bs / fwd_dt
+
+    out = feat.transform(table)  # warm (shares the compiled program above)
+    assert out["features"].shape[0] == N
+    e2e_dt = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        feat.transform(table)
+        dt = time.perf_counter() - t0
+        e2e_dt = dt if e2e_dt is None else min(e2e_dt, dt)
+    e2e_ips = N / e2e_dt
+
+    ratio = e2e_ips / fwd_ips
+    assert ratio >= MIN_RATIO, (
+        f"feed overhead too high: e2e {e2e_ips:.1f} img/s is only "
+        f"{ratio:.0%} of forward-only {fwd_ips:.1f} img/s")
