@@ -5,6 +5,7 @@ HTTPSinkV2/DistributedHTTPSource, SURVEY §2.4) — sub-millisecond data path:
 accept, batch, jitted transform, reply over the held socket.
 """
 from .dsl import DistributedServingServer, StreamingQuery, StreamReader, read_stream
+from .journal import EpochJournal
 from .registry import ServiceRegistry, list_services, register_service
 from .server import (
     CachedRequest,
@@ -16,6 +17,7 @@ from .server import (
 )
 
 __all__ = [
+    "EpochJournal",
     "ServingServer",
     "WorkerServer",
     "CachedRequest",

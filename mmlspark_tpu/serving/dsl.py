@@ -87,6 +87,7 @@ class StreamReader:
         self._max_batch = 64
         self._batch_timeout_ms = 10.0
         self._trigger_interval_ms = 20.0
+        self._journal_path: Optional[str] = None
 
     # ---- sources (IOImplicits server/distributedServer/continuousServer)
     def server(self, host: str = "127.0.0.1", port: int = 0,
@@ -139,13 +140,19 @@ class StreamReader:
 
     def options(self, max_batch: Optional[int] = None,
                 batch_timeout_ms: Optional[float] = None,
-                trigger_interval_ms: Optional[float] = None) -> "StreamReader":
+                trigger_interval_ms: Optional[float] = None,
+                journal_path: Optional[str] = None) -> "StreamReader":
+        """journal_path is the `checkpointLocation` analog: accepted
+        requests survive process restart (replicas > 1 each get their own
+        `<path>-<replica>` file)."""
         if max_batch is not None:
             self._max_batch = int(max_batch)
         if batch_timeout_ms is not None:
             self._batch_timeout_ms = float(batch_timeout_ms)
         if trigger_interval_ms is not None:
             self._trigger_interval_ms = float(trigger_interval_ms)
+        if journal_path is not None:
+            self._journal_path = journal_path
         return self
 
     # ---- sink ----------------------------------------------------------
@@ -162,7 +169,10 @@ class StreamReader:
                 host=self._host, port=self._port, path=self._path,
                 input_schema=self._schema, max_batch=self._max_batch,
                 batch_timeout_ms=self._batch_timeout_ms, mode=self._mode,
-                trigger_interval_ms=self._trigger_interval_ms)
+                trigger_interval_ms=self._trigger_interval_ms,
+                journal_path=(None if self._journal_path is None
+                              else self._journal_path if self._replicas == 1
+                              else f"{self._journal_path}-{r}"))
             info = srv.start()
             if self._registry_url:
                 register_service(self._registry_url,

@@ -279,6 +279,42 @@ def test_serving_latency_qps_regression():
     assert_benchmark(bench, "serving_qps", qps)
 
 
+def test_serving_serial_latency_sub_ms():
+    """The reference's sub-millisecond claim (docs/mmlspark-serving.md:10)
+    is a SERIAL loopback number — one client, persistent connection.  With
+    HTTP/1.1 keep-alive + TCP_NODELAY on the worker server the whole
+    accept -> batch -> transform -> reply path fits under a millisecond at
+    the median even on this 1-core container; the concurrent-load numbers
+    above are queueing on the single core, not stack overhead."""
+    import http.client
+
+    srv = ServingServer(
+        model=LambdaTransformer(
+            lambda t: t.with_column("out", np.asarray(t["x"], np.float64))),
+        reply_col="out", name="ser", path="/ser", batch_timeout_ms=2.0,
+    )
+    info = srv.start()
+    body = json.dumps({"x": 1}).encode()
+    hdrs = {"Content-Type": "application/json"}
+    try:
+        conn = http.client.HTTPConnection(info.host, info.port)
+        lat = []
+        for i in range(300):
+            t0 = time.perf_counter()
+            conn.request("POST", "/ser", body, hdrs)
+            resp = conn.getresponse()
+            resp.read()
+            lat.append(time.perf_counter() - t0)
+            assert resp.status == 200
+        conn.close()
+    finally:
+        srv.stop()
+    p50 = float(np.percentile(np.asarray(lat[50:]) * 1000.0, 50))
+    bench = load_benchmarks("benchmarks_serving.csv")
+    assert_benchmark(bench, "serving_p50_serial_ms", p50)
+    assert p50 < 1.0, f"serial loopback p50 {p50:.2f}ms not sub-ms"
+
+
 # ------------------------------------------------- readStream DSL parity
 
 def test_read_stream_dsl_end_to_end():
