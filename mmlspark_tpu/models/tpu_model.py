@@ -12,6 +12,7 @@ output coercion (:468-493) are handled host-side.
 from __future__ import annotations
 
 from collections import OrderedDict
+from functools import partial
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -60,25 +61,34 @@ class ImagePreprocess:
         self.__dict__.update(state)
         self.__dict__.setdefault("use_pallas", None)
 
-    def _pallas_wanted(self) -> bool:
+    def _pallas_wanted(self, mesh=None) -> bool:
         if self.use_pallas is False:
             return False
         if self.use_pallas is None:
-            # auto mode: Mosaic kernels are not GSPMD-partitionable, so the
-            # fused kernel only auto-enables on single-device TPU programs
-            # (multi-chip sharded forwards keep the XLA composition; a
-            # shard_map-wrapped variant can opt in with use_pallas=True)
-            return jax.default_backend() == "tpu" and jax.device_count() == 1
+            # auto mode: the fused Mosaic kernel on TPU.  Multi-device
+            # programs need a mesh so the kernel can launch per-shard under
+            # shard_map (Mosaic kernels are not GSPMD-partitionable); a
+            # mesh-less caller on a multi-device runtime keeps the XLA
+            # composition rather than embedding an unpartitionable custom
+            # call in a possibly-sharded jit.
+            return jax.default_backend() == "tpu" and (
+                jax.device_count() == 1 or mesh is not None)
         return True
 
-    def __call__(self, batch):
+    def __call__(self, batch, mesh=None):
         from ..ops import image as I
 
         if batch.shape[-1] == 1:  # gray -> 3-channel
             batch = jnp.repeat(batch, 3, axis=-1)
         elif batch.shape[-1] == 4:  # BGRA -> BGR
             batch = batch[..., :3]
-        if self._pallas_wanted():
+        dp = mesh.shape.get("data", 1) if mesh is not None else 1
+        multi = mesh is not None and mesh.devices.size > 1
+        # a multi-device mesh can take the kernel only per-shard, which
+        # needs a dp-divisible batch (TPUModel always pads to one); other
+        # multi-device layouts fall through to the partitionable XLA path
+        shardable = not multi or (dp > 1 and batch.shape[0] % dp == 0)
+        if self._pallas_wanted(mesh) and shardable:
             from ..ops.pallas_kernels import fused_resize_normalize
 
             # cast + bilinear resize + normalize: one VMEM-resident kernel
@@ -93,8 +103,18 @@ class ImagePreprocess:
             else:
                 mean = (0.0,) * batch.shape[-1]
                 std = (1.0,) * batch.shape[-1]
-            return fused_resize_normalize(batch, self.height, self.width,
-                                          mean, std)
+            fused = partial(fused_resize_normalize, h_out=self.height,
+                            w_out=self.width, mean=mean, std=std)
+            if multi:
+                # per-shard kernel launch on a batch-sharded input: each
+                # device runs the Mosaic program on its local [B/dp,...]
+                # block — no cross-device deps, so no collectives appear
+                from jax.experimental.shard_map import shard_map
+
+                spec = batch_sharding(mesh, batch.ndim).spec
+                return shard_map(fused, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec, check_rep=False)(batch)
+            return fused(batch)
         x = batch.astype(jnp.float32)
         if x.shape[1] != self.height or x.shape[2] != self.width:
             x = I.resize(x, self.height, self.width)
@@ -181,7 +201,10 @@ class TPUModel(Transformer):
 
         def forward(variables, batch):
             if pre is not None:
-                batch = pre(batch)
+                # ImagePreprocess gets the mesh so its fused Mosaic kernel
+                # can run per-shard on multi-device programs
+                batch = (pre(batch, mesh=mesh)
+                         if isinstance(pre, ImagePreprocess) else pre(batch))
             taps = bundle.apply(variables, batch)
             if fetch not in taps:
                 raise KeyError(

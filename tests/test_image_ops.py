@@ -222,6 +222,59 @@ def test_image_preprocess_pallas_matches_xla_path():
                                atol=1e-4, rtol=1e-4)
 
 
+def test_image_preprocess_pallas_sharded_matches_xla_path():
+    """The shard_map-wrapped fused kernel on a dp=8 mesh (the multi-chip
+    variant promised by ImagePreprocess._pallas_wanted's auto mode) must
+    agree with the XLA composition — per-shard Mosaic launches on a
+    batch-sharded input, interpret mode here, same code path on chips."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.tpu_model import ImagePreprocess
+    from mmlspark_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    mesh = make_mesh()  # all 8 virtual devices on the data axis
+    rng = np.random.default_rng(9)
+    xs = rng.integers(0, 256, size=(16, 30, 24, 3), dtype=np.uint8)
+    x = jax.device_put(xs, batch_sharding(mesh, xs.ndim))
+    mean = [103.5, 116.3, 123.7]
+    std = [57.4, 57.1, 58.4]
+    pre_on = ImagePreprocess(16, 12, mean=mean, std=std, use_pallas=True)
+    pre_off = ImagePreprocess(16, 12, mean=mean, std=std, use_pallas=False)
+    on = jax.jit(lambda b: pre_on(b, mesh=mesh))(x)
+    off = jax.jit(lambda b: pre_off(b, mesh=mesh))(x)
+    assert on.shape == (16, 16, 12, 3)
+    np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_image_preprocess_sharded_fallbacks_stay_correct():
+    """Multi-device layouts the per-shard kernel can't take — a batch not
+    divisible by dp, or a mesh with data=1 — must fall back to the XLA
+    composition, not error or replicate an unpartitionable kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.tpu_model import ImagePreprocess
+    from mmlspark_tpu.parallel.mesh import make_mesh
+
+    pre = ImagePreprocess(16, 12, mean=[100.0], std=[50.0], use_pallas=True)
+    ref = ImagePreprocess(16, 12, mean=[100.0], std=[50.0], use_pallas=False)
+    rng = np.random.default_rng(10)
+
+    # batch of 12 on a dp=8 mesh: 12 % 8 != 0 -> XLA path
+    mesh = make_mesh()
+    x = jnp.asarray(rng.integers(0, 256, (12, 30, 24, 3), np.uint8))
+    np.testing.assert_allclose(np.asarray(pre(x, mesh=mesh)),
+                               np.asarray(ref(x)), atol=1e-4, rtol=1e-4)
+
+    # model-parallel-only mesh (data=1, 8 devices): XLA path
+    mp_mesh = make_mesh(data=1, model=8)
+    x2 = jnp.asarray(rng.integers(0, 256, (8, 30, 24, 3), np.uint8))
+    np.testing.assert_allclose(np.asarray(pre(x2, mesh=mp_mesh)),
+                               np.asarray(ref(x2)), atol=1e-4, rtol=1e-4)
+
+
 @pytest.mark.skipif("__import__('jax').default_backend() != 'tpu'",
                     reason="Mosaic compile check needs a real TPU")
 def test_pallas_kernels_compile_on_tpu():
