@@ -41,9 +41,12 @@ def two_class_images(n=48, seed=0):
 
 
 def main():
-    table = two_class_images()
+    # MMLSPARK_EXAMPLE_FAST=1 shrinks the run for smoke tests (CI)
+    fast = os.environ.get("MMLSPARK_EXAMPLE_FAST") not in (None, "", "0")
+    epochs = 1 if fast else 3
+    table = two_class_images(n=16 if fast else 48)
     with tempfile.TemporaryDirectory() as ck:
-        est = DeepVisionClassifier(backbone="resnet18", epochs=3,
+        est = DeepVisionClassifier(backbone="resnet18", epochs=epochs,
                                    batch_size=16, learning_rate=0.05,
                                    checkpoint_dir=ck)
         model = est.fit(table)
@@ -54,7 +57,7 @@ def main():
         print("train accuracy:", acc)
 
         # interrupted? the same checkpoint_dir resumes instead of restarting
-        resumed = DeepVisionClassifier(backbone="resnet18", epochs=4,
+        resumed = DeepVisionClassifier(backbone="resnet18", epochs=epochs + 1,
                                        batch_size=16, learning_rate=0.05,
                                        checkpoint_dir=ck).fit(table)
         print("resume trained", len(resumed.loss_history),

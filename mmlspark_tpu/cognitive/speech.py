@@ -59,11 +59,84 @@ class WavStream:
 
     def windows(self, window_ms: int) -> Iterator[Tuple[float, bytes]]:
         """(offset_ms, pcm_window) pairs of whole frames."""
-        frames_per_window = max(int(self.sample_rate * window_ms / 1000.0), 1)
+        rate = max(self.sample_rate, 1)  # corrupt fmt chunks declare 0
+        frames_per_window = max(int(rate * window_ms / 1000.0), 1)
         step = frames_per_window * self.frame_bytes
         for off in range(0, len(self.pcm), step):
-            offset_ms = 1000.0 * (off // self.frame_bytes) / self.sample_rate
+            offset_ms = 1000.0 * (off // self.frame_bytes) / rate
             yield offset_ms, self.pcm[off:off + step]
+
+    def utterances(self, silence_ms: int = 300, frame_ms: int = 30,
+                   energy_threshold: Optional[float] = None,
+                   min_utterance_ms: int = 100,
+                   max_utterance_ms: int = 20000
+                   ) -> Iterator[Tuple[float, bytes]]:
+        """(offset_ms, pcm_segment) per detected utterance — energy/silence
+        endpointing over PCM frames, the native SDK's event-driven
+        continuous-recognition semantics (SpeechToTextSDK.scala:76-489):
+        segments end at pauses, never mid-word.
+
+        A frame is voiced when its RMS exceeds the threshold (auto: the
+        louder of ~1% full scale and 2x the 20th-percentile frame RMS, so
+        both digital silence and mild noise floors endpoint cleanly).  A
+        run of `silence_ms` unvoiced frames closes the utterance; segments
+        get one frame of leading/trailing context, blips shorter than
+        `min_utterance_ms` are dropped, and `max_utterance_ms` force-splits
+        so one long monologue can't become an unbounded request.  Non-16-bit
+        PCM falls back to fixed `max_utterance_ms` windows (no decode path).
+        """
+        if self.bits_per_sample != 16 or not self.pcm:
+            yield from self.windows(max_utterance_ms)
+            return
+        x = np.frombuffer(
+            self.pcm[:len(self.pcm) - len(self.pcm) % self.frame_bytes],
+            dtype="<i2").astype(np.float32)
+        if self.channels > 1:
+            x = x.reshape(-1, self.channels).mean(axis=1)
+        rate = max(self.sample_rate, 1)  # corrupt fmt chunks declare 0
+        spf = max(int(rate * frame_ms / 1000.0), 1)
+        n_frames = -(-len(x) // spf)
+        pad = np.zeros(n_frames * spf - len(x), np.float32)
+        frames = np.concatenate([x, pad]).reshape(n_frames, spf)
+        rms = np.sqrt(np.mean(frames * frames, axis=1))
+        if energy_threshold is None:
+            # 2x the quiet end of the tape (but at least ~1% full scale),
+            # capped at half its loud end so quiet-but-real speech and
+            # tapes with no silence both stay voiced; the ~0.2%-scale
+            # outer floor keeps a noise-only tape from becoming speech
+            floor = float(np.percentile(rms, 20)) if n_frames else 0.0
+            loud = float(np.percentile(rms, 95)) if n_frames else 0.0
+            energy_threshold = max(
+                65.0, min(max(327.0, 2.0 * floor), 0.5 * loud))
+        voiced = rms > energy_threshold
+        silence_frames = max(int(round(silence_ms / frame_ms)), 1)
+        min_frames = max(int(round(min_utterance_ms / frame_ms)), 1)
+        max_frames = max(int(round(max_utterance_ms / frame_ms)), 1)
+
+        def emit(f0: int, f1: int):
+            # one frame of context each side; slice whole PCM frames
+            f0, f1 = max(f0 - 1, 0), min(f1 + 1, n_frames)
+            s0, s1 = f0 * spf, min(f1 * spf, len(x))
+            off_ms = 1000.0 * s0 / rate
+            return off_ms, self.pcm[s0 * self.frame_bytes:
+                                    s1 * self.frame_bytes]
+
+        start = None   # first voiced frame of the open utterance
+        last_voiced = None
+        for f in range(n_frames):
+            if voiced[f]:
+                if start is None:
+                    start = f
+                last_voiced = f
+                if f - start + 1 >= max_frames:  # force-split
+                    yield emit(start, f + 1)
+                    start = last_voiced = None
+            elif start is not None and f - last_voiced >= silence_frames:
+                if last_voiced - start + 1 >= min_frames:
+                    yield emit(start, last_voiced + 1)
+                start = last_voiced = None
+        if start is not None and last_voiced - start + 1 >= min_frames:
+            yield emit(start, last_voiced + 1)
 
     def window_wav(self, pcm_window: bytes) -> bytes:
         """Re-wrap a PCM window in a minimal WAV container so each request
@@ -93,12 +166,14 @@ class CompressedStream:
 class SpeechToTextSDK(CognitiveServicesBase):
     """Continuous recognition over per-row audio streams.
 
-    Reference: SpeechToTextSDK.scala:76-489.  Each row's audio is windowed
-    (WavStream frame-aligned for wav; byte windows otherwise) and every
-    window is recognized as one utterance; `output_col` holds the ordered
-    list of result dicts, each annotated with its stream offset.  With
-    `flatten_results` the stage emits one row per utterance instead — the
-    reference's emitted-row shape.
+    Reference: SpeechToTextSDK.scala:76-489.  Wav rows are segmented at
+    silence boundaries (energy endpointing over PCM frames — the native
+    SDK recognizer's event-driven utterance semantics; words are never
+    split at arbitrary window edges); compressed rows fall back to fixed
+    byte windows.  Every segment is recognized as one utterance;
+    `output_col` holds the ordered list of result dicts, each annotated
+    with its stream offset.  With `flatten_results` the stage emits one
+    row per utterance instead — the reference's emitted-row shape.
     """
 
     _domain = "stt.speech.microsoft.com"
@@ -107,7 +182,18 @@ class SpeechToTextSDK(CognitiveServicesBase):
     language = ServiceParam("recognition language", default="en-US")
     format = Param("simple|detailed", default="simple")
     stream_format = Param("wav|compressed (windowing strategy)", default="wav")
-    window_ms = Param("recognition window for wav streams (ms)", default=2000,
+    segmentation = Param(
+        "utterance|window — wav streams segment at silence boundaries "
+        "(energy endpointing; the native SDK's continuous-recognition "
+        "semantics) or into fixed window_ms windows", default="utterance")
+    silence_ms = Param("pause length that ends an utterance", default=300,
+                       converter=TypeConverters.to_int)
+    energy_threshold = Param("RMS frame-energy voicing threshold "
+                             "(None = adaptive)", default=None)
+    max_utterance_ms = Param("force-split utterances longer than this",
+                             default=20000, converter=TypeConverters.to_int)
+    window_ms = Param("recognition window for wav streams (ms) when "
+                      "segmentation='window'", default=2000,
                       converter=TypeConverters.to_int)
     window_bytes = Param("recognition window for compressed streams (bytes)",
                          default=32768, converter=TypeConverters.to_int)
@@ -122,15 +208,33 @@ class SpeechToTextSDK(CognitiveServicesBase):
                        "format": self.format})
         return f"{base}{sep}{q}"
 
+    def _check_segmentation(self) -> str:
+        seg_mode = self.get_or_default("segmentation")
+        if seg_mode not in ("utterance", "window"):
+            raise ValueError(
+                f"segmentation must be 'utterance' or 'window', got "
+                f"{seg_mode!r}")
+        return seg_mode
+
     def _windows(self, audio: bytes):
         if self.stream_format == "wav":
             stream = WavStream(bytes(audio))
-            return [(off, stream.window_wav(w))
-                    for off, w in stream.windows(int(self.window_ms))]
+            if self.get_or_default("segmentation") == "utterance":
+                thr = self.get_or_default("energy_threshold")
+                segs = stream.utterances(
+                    silence_ms=int(self.silence_ms),
+                    energy_threshold=None if thr is None else float(thr),
+                    max_utterance_ms=int(self.max_utterance_ms))
+            else:
+                segs = stream.windows(int(self.window_ms))
+            return [(off, stream.window_wav(w)) for off, w in segs]
         stream = CompressedStream(bytes(audio))
         return list(stream.windows(int(self.window_bytes)))
 
     def _transform(self, table: Table) -> Table:
+        # validate config BEFORE the per-row loop: a typo'd segmentation
+        # value must fail the stage, not be swallowed as a row error
+        self._check_segmentation()
         n = len(table)
         audio_col = table[self.audio_col]
         # every window of every row is one request through the shared

@@ -438,7 +438,7 @@ def test_speech_sdk_streaming_continuous(mock_url):
     _MockService.speech_calls = 0
     out = SpeechToTextSDK(
         url=f"{mock_url}/speech/recognition/conversation/cognitiveservices/v1",
-        window_ms=250, concurrency=1).transform(t)
+        window_ms=250, segmentation="window", concurrency=1).transform(t)
     segs = out["output"][0]
     assert len(segs) == 4
     assert [s["StreamOffsetMs"] for s in segs] == [0.0, 250.0, 500.0, 750.0]
@@ -456,7 +456,8 @@ def test_speech_sdk_flatten_results(mock_url):
     t = Table({"audio": audio, "rowid": np.array([10, 20])})
     out = SpeechToTextSDK(
         url=f"{mock_url}/speech/recognition/conversation/cognitiveservices/v1",
-        window_ms=250, flatten_results=True, concurrency=1).transform(t)
+        window_ms=250, segmentation="window", flatten_results=True,
+        concurrency=1).transform(t)
     # 2 + 1 utterances, each a row carrying its source row's columns
     assert len(out) == 3
     assert list(out["rowid"]) == [10, 10, 20]
@@ -495,7 +496,7 @@ def test_speech_sdk_corrupt_audio_isolated(mock_url):
     t = Table({"audio": audio})
     out = SpeechToTextSDK(
         url=f"{mock_url}/speech/recognition/conversation/cognitiveservices/v1",
-        window_ms=250).transform(t)
+        window_ms=250, segmentation="window").transform(t)
     assert out["output"][0] == [] and "decode failed" in out["errors"][0]
     assert len(out["output"][1]) == 1 and out["errors"][1] is None
 
@@ -521,7 +522,7 @@ def test_conversation_transcription_query_joining(mock_url):
     out = ConversationTranscription(
         url=(f"{mock_url}/speech/recognition/conversation/cognitiveservices"
              "/v1?transcriptionMode=conversation"),
-        window_ms=250).transform(t)
+        window_ms=250, segmentation="window").transform(t)
     segs = out["output"][0]
     assert len(segs) == 2
     assert [s["StreamOffsetMs"] for s in segs] == [0.0, 250.0]
@@ -531,3 +532,141 @@ def test_conversation_transcription_query_joining(mock_url):
         assert e["path"].count("?") == 1
         assert "transcriptionMode=conversation" in e["path"]
         assert "&language=" in e["path"]
+
+
+# ------------------------------------------------ utterance endpointing
+
+def _make_speech_wav(segments, rate=16000, amp=8000):
+    """PCM with spoken bursts separated by silence: segments is a list of
+    (duration_s, voiced) pairs."""
+    import struct as _struct
+
+    samples = []
+    for dur, voiced in segments:
+        n = int(dur * rate)
+        if voiced:
+            tt = np.arange(n)
+            samples.append((amp * np.sin(2 * np.pi * 220 * tt / rate))
+                           .astype(np.int16))
+        else:
+            samples.append(np.zeros(n, np.int16))
+    pcm = np.concatenate(samples).tobytes()
+    hdr = _struct.pack("<4sI4s4sIHHIIHH4sI", b"RIFF", 36 + len(pcm), b"WAVE",
+                       b"fmt ", 16, 1, 1, rate, rate * 2, 2, 16,
+                       b"data", len(pcm))
+    return hdr + pcm
+
+
+def test_wav_stream_utterance_endpointing():
+    """A spoken-pause fixture splits at the silences, never mid-utterance
+    (SpeechToTextSDK.scala:76-489 continuous-recognizer semantics)."""
+    from mmlspark_tpu.cognitive import WavStream
+
+    wav = _make_speech_wav([(0.3, True), (0.5, False), (0.4, True)])
+    utts = list(WavStream(wav).utterances(silence_ms=300))
+    assert len(utts) == 2
+    # offsets land at the utterance starts (one 30ms context frame early)
+    assert utts[0][0] == pytest.approx(0.0, abs=65.0)
+    assert utts[1][0] == pytest.approx(800.0, abs=65.0)
+    # each segment covers its burst (within a context frame either side)
+    for (off, pcm), want_ms in zip(utts, (300.0, 400.0)):
+        dur = 1000.0 * (len(pcm) // 2) / 16000
+        assert dur == pytest.approx(want_ms, abs=80.0)
+
+
+def test_wav_stream_utterance_blip_and_force_split():
+    from mmlspark_tpu.cognitive import WavStream
+
+    # a 40ms blip is dropped (min_utterance_ms=100)
+    wav = _make_speech_wav([(0.2, False), (0.04, True), (0.3, False)])
+    assert list(WavStream(wav).utterances()) == []
+    # a long monologue force-splits at max_utterance_ms
+    wav = _make_speech_wav([(1.0, True)])
+    utts = list(WavStream(wav).utterances(max_utterance_ms=400))
+    assert len(utts) >= 2
+    assert all(1000.0 * (len(p) // 2) / 16000 <= 500.0 for _, p in utts)
+
+
+def test_wav_stream_all_silence_yields_nothing():
+    from mmlspark_tpu.cognitive import WavStream
+
+    assert list(WavStream(_make_speech_wav([(0.5, False)])).utterances()) == []
+
+
+def test_speech_sdk_utterance_segmentation(mock_url):
+    """Default wav behavior: one request per spoken utterance, split at
+    the pause — not at 2000ms window edges."""
+    from mmlspark_tpu.cognitive import SpeechToTextSDK, WavStream
+
+    audio = np.empty(1, dtype=object)
+    audio[0] = _make_speech_wav([(0.3, True), (0.5, False), (0.4, True)])
+    t = Table({"audio": audio})
+    out = SpeechToTextSDK(
+        url=f"{mock_url}/speech/recognition/conversation/cognitiveservices/v1",
+        concurrency=1).transform(t)
+    segs = out["output"][0]
+    assert len(segs) == 2
+    assert segs[0]["StreamOffsetMs"] == pytest.approx(0.0, abs=65.0)
+    assert segs[1]["StreamOffsetMs"] == pytest.approx(800.0, abs=65.0)
+    # every utterance shipped as a self-contained parseable wav
+    # (mock echoes the byte count: header + pcm)
+    for seg, want_ms in zip(segs, (300.0, 400.0)):
+        pcm_bytes = seg["bytes"] - 44
+        assert 1000.0 * (pcm_bytes // 2) / 16000 == pytest.approx(
+            want_ms, abs=80.0)
+
+
+def test_wav_stream_quiet_speech_still_voiced():
+    """Quiet-but-real speech (~1.4% full scale) must not be dropped by the
+    adaptive threshold's absolute floor."""
+    from mmlspark_tpu.cognitive import WavStream
+
+    wav = _make_speech_wav([(0.3, True), (0.5, False), (0.4, True)], amp=450)
+    utts = list(WavStream(wav).utterances(silence_ms=300))
+    assert len(utts) == 2
+
+
+def test_wav_stream_noise_only_not_voiced():
+    from mmlspark_tpu.cognitive import WavStream
+    import struct as _struct
+
+    rng = np.random.default_rng(3)
+    pcm = rng.integers(-8, 8, 16000, np.int16).tobytes()  # tiny noise floor
+    hdr = _struct.pack("<4sI4s4sIHHIIHH4sI", b"RIFF", 36 + len(pcm), b"WAVE",
+                       b"fmt ", 16, 1, 1, 16000, 32000, 2, 16,
+                       b"data", len(pcm))
+    assert list(WavStream(hdr + pcm).utterances()) == []
+
+
+def test_speech_sdk_zero_sample_rate_isolated(mock_url):
+    """A wav whose fmt chunk declares sample_rate=0 must not crash the
+    stage (per-row failure isolation)."""
+    from mmlspark_tpu.cognitive import SpeechToTextSDK
+    import struct as _struct
+
+    pcm = (np.full(8000, 5000, np.int16)).tobytes()
+    bad = _struct.pack("<4sI4s4sIHHIIHH4sI", b"RIFF", 36 + len(pcm), b"WAVE",
+                       b"fmt ", 16, 1, 1, 0, 0, 2, 16, b"data", len(pcm))
+    audio = np.empty(2, dtype=object)
+    audio[0] = bad + pcm
+    audio[1] = _make_speech_wav([(0.3, True)])
+    t = Table({"audio": audio})
+    out = SpeechToTextSDK(
+        url=f"{mock_url}/speech/recognition/conversation/cognitiveservices/v1",
+        concurrency=1).transform(t)
+    # the zero-rate row still segments (rate clamped to 1) or errors — but
+    # the GOOD row must come through either way
+    assert len(out["output"][1]) == 1
+
+
+def test_speech_sdk_segmentation_typo_rejected(mock_url):
+    from mmlspark_tpu.cognitive import SpeechToTextSDK
+
+    audio = np.empty(1, dtype=object)
+    audio[0] = _make_speech_wav([(0.3, True)])
+    t = Table({"audio": audio})
+    with pytest.raises(ValueError, match="segmentation"):
+        SpeechToTextSDK(
+            url=(f"{mock_url}/speech/recognition/conversation/"
+                 "cognitiveservices/v1"),
+            segmentation="utterances").transform(t)
