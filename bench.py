@@ -146,6 +146,65 @@ def _measure_train(batch: int = 256, steps: int = 40) -> dict:
     return {"train_samples_per_sec": round(steps * batch / dt, 1)}
 
 
+def _measure_transformer(batch: int = 16, seq: int = 1024,
+                         steps: int = 8) -> dict:
+    """TransformerLM train-step throughput + MFU — the matmul-dominated
+    workload where high MFU is actually available on the MXU (the CNN
+    forward's roofline caps near 0.47; see tools/roofline.py and
+    docs/performance.md).  GPT-small-ish config, bf16, fwd+bwd+adam as
+    ONE jitted step; FLOPs from XLA's own cost analysis."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    model = transformer_lm(vocab_size=8192, embed_dim=768, num_layers=12,
+                           num_heads=12, max_len=seq, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (batch, seq), 0, 8192, jnp.int32)
+    params = jax.jit(lambda r, t: model.init(r, t)["params"])(rng, tokens)
+    opt = optax.adam(3e-4)
+    opt_state = jax.jit(opt.init)(params)
+
+    def step(params, opt_state, toks):
+        def loss_fn(p):
+            logits, _ = model.apply({"params": p}, toks)
+            tgt = toks[:, 1:]
+            lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            ll = jnp.take_along_axis(lp, tgt[..., None], axis=-1)
+            return -jnp.mean(ll)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    compiled = jax.jit(step, donate_argnums=(0, 1)).lower(
+        params, opt_state, tokens).compile()
+    try:
+        flops = float(compiled.cost_analysis()["flops"])
+    except Exception:  # noqa: BLE001
+        flops = 0.0
+    params, opt_state, loss = compiled(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    best = None
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = compiled(params, opt_state, tokens)
+        jax.block_until_ready(loss)
+        dt = _time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    peak = _chip_peak_flops()
+    return {
+        "lm_tokens_per_sec": round(steps * batch * seq / best, 0),
+        "lm_train_mfu": (round(steps * flops / best / peak, 4)
+                         if peak and flops else None),
+    }
+
+
 def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     import jax
     import jax.numpy as jnp
@@ -256,7 +315,11 @@ def _child_measure():
     except Exception as e:  # noqa: BLE001 — train bench must not kill the record
         train = {"train_samples_per_sec": None,
                  "train_error": str(e)[-200:]}
-    print(json.dumps({"res": res, "train": train}))
+    try:
+        lm = _measure_transformer()
+    except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
+        lm = {"lm_error": str(e)[-200:]}
+    print(json.dumps({"res": res, "train": train, "lm": lm}))
 
 
 def main():
@@ -352,6 +415,7 @@ def main():
         **({"train_error": train["train_error"]}
            if train.get("train_samples_per_sec") is None
            and "train_error" in train else {}),
+        **{k: v for k, v in child.get("lm", {}).items() if v is not None},
         "device_kind": res["device_kind"],
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

@@ -5,10 +5,6 @@ winning transformer + all evaluation results).
 """
 from __future__ import annotations
 
-from typing import List
-
-import numpy as np
-
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage

@@ -14,9 +14,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core.params import ComplexParam, Param, ServiceParam, TypeConverters
+from ..core.params import Param, ServiceParam, TypeConverters
 from ..core.pipeline import Transformer
-from ..core.registry import register_stage
 from ..core.schema import Table
 from ..io.http.clients import AsyncHTTPClient, HandlingUtils, get_shared_client
 from ..io.http.schema import HTTPRequestData, HTTPResponseData

@@ -7,7 +7,7 @@ BingImageSearch.scala (309 LoC).
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+from typing import Dict, List
 from urllib.parse import urlencode
 
 from ..core.params import Param, ServiceParam, TypeConverters

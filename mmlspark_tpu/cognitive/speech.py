@@ -190,6 +190,8 @@ class SpeechToTextSDK(CognitiveServicesBase):
                        converter=TypeConverters.to_int)
     energy_threshold = Param("RMS frame-energy voicing threshold "
                              "(None = adaptive)", default=None)
+    min_utterance_ms = Param("drop voiced blips shorter than this",
+                             default=100, converter=TypeConverters.to_int)
     max_utterance_ms = Param("force-split utterances longer than this",
                              default=20000, converter=TypeConverters.to_int)
     window_ms = Param("recognition window for wav streams (ms) when "
@@ -224,6 +226,7 @@ class SpeechToTextSDK(CognitiveServicesBase):
                 segs = stream.utterances(
                     silence_ms=int(self.silence_ms),
                     energy_threshold=None if thr is None else float(thr),
+                    min_utterance_ms=int(self.min_utterance_ms),
                     max_utterance_ms=int(self.max_utterance_ms))
             else:
                 segs = stream.windows(int(self.window_ms))

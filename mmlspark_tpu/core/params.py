@@ -12,9 +12,7 @@ from __future__ import annotations
 
 import copy
 import uuid
-from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
-
-import numpy as np
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 
 __all__ = ["Param", "ComplexParam", "ServiceParam", "Params", "TypeConverters"]
 

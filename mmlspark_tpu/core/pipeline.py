@@ -8,9 +8,9 @@ lightgbm/LightGBMBase.scala:43 train), plus `NamespaceInjections.pipelineModel`
 """
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
-from .params import ComplexParam, Param, Params
+from .params import ComplexParam, Params
 from .schema import Table
 from .telemetry import log_verb
 

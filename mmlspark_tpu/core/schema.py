@@ -14,7 +14,7 @@ Spark's column metadata (categorical maps, label/score tagging):
 from __future__ import annotations
 
 import numpy as np
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Mapping, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Mapping, Optional, Sequence
 
 __all__ = [
     "Table",

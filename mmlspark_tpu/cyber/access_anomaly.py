@@ -13,7 +13,7 @@ factor in one call) instead of Spark ALS.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

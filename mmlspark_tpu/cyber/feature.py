@@ -6,11 +6,11 @@ scalers (statistics computed independently per partition key, e.g. tenant).
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 
-from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.params import ComplexParam, Param
 from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
 from ..core.schema import Table

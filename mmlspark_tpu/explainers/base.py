@@ -12,7 +12,7 @@ per-target regression is solved in one vmapped jit call
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
@@ -201,8 +201,6 @@ class KernelSHAPBase(LocalExplainer):
         # out[1] stays null
         if dim <= 1:
             return out
-        from math import comb
-
         sizes = np.arange(1, dim)
         mass = (dim - 1) / (sizes * (dim - sizes))
         mass = mass / mass.sum()

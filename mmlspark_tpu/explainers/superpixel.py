@@ -10,8 +10,6 @@ per-cluster pixel lists walked on the JVM heap).
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..core.params import Param, TypeConverters

@@ -5,8 +5,6 @@ imputation over numeric columns, NaN/None treated as missing.
 """
 from __future__ import annotations
 
-from typing import List, Optional
-
 import numpy as np
 
 from ..core.params import ComplexParam, Param, TypeConverters

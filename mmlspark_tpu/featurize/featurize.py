@@ -11,7 +11,7 @@ device_put-able; hashing uses crc32 (deterministic across processes).
 from __future__ import annotations
 
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Dict
 
 import numpy as np
 

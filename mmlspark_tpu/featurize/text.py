@@ -7,7 +7,7 @@ MultiNGram.scala and PageSplitter.scala.
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Optional
+from typing import List
 
 from .featurize import _hash_token
 

@@ -7,7 +7,7 @@ columns, storing the level map in column metadata so downstream stages
 """
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Optional
 
 import numpy as np
 

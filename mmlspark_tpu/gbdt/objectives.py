@@ -8,7 +8,7 @@ lambdarank.  All are vectorized numpy/jax; a custom objective is any callable
 """
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable
 
 import numpy as np
 

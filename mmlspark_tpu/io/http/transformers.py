@@ -9,8 +9,6 @@ StringOutputParser, CustomOutputParser).
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Dict, Optional
-
 import numpy as np
 
 from ...core.params import ComplexParam, Param, TypeConverters

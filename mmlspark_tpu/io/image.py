@@ -11,7 +11,7 @@ HWC uint8 ndarray in **BGR** channel order (OpenCV/Spark convention).
 from __future__ import annotations
 
 import io as _io
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 

@@ -6,8 +6,6 @@ through the HTTP retry stack).
 from __future__ import annotations
 
 import json
-from typing import Optional
-
 import numpy as np
 
 from ..core.schema import Table

@@ -12,8 +12,6 @@ device, MXU/VPU-friendly gathers instead of per-row recursion.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

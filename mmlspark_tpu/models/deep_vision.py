@@ -74,7 +74,7 @@ class DeepVisionClassifier(Estimator):
         import jax.numpy as jnp
         import optax
 
-        from ..parallel.mesh import MeshContext, batch_sharding, default_mesh
+        from ..parallel.mesh import MeshContext, default_mesh
         from .bundle import get_builder
         from .training import TrainState, init_train_state, scan_slice_steps
 

@@ -8,8 +8,6 @@ contract every learner implements.
 """
 from __future__ import annotations
 
-from typing import List, Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -12,12 +12,11 @@ output-backwards: ["logits", "pool", "res5", "res4", "res3", "res2", "stem"].
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
            "LAYER_NAMES", "init_resnet"]

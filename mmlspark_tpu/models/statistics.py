@@ -7,11 +7,11 @@ metric names follow core/metrics/MetricConstants.scala.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict
 
 import numpy as np
 
-from ..core.params import Param, TypeConverters
+from ..core.params import Param
 from ..core.pipeline import Transformer
 from ..core.registry import register_stage
 from ..core.schema import Table

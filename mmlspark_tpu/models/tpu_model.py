@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from collections import OrderedDict
 from functools import partial
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -109,11 +109,16 @@ class ImagePreprocess:
                 # per-shard kernel launch on a batch-sharded input: each
                 # device runs the Mosaic program on its local [B/dp,...]
                 # block — no cross-device deps, so no collectives appear
-                from jax.experimental.shard_map import shard_map
-
                 spec = batch_sharding(mesh, batch.ndim).spec
-                return shard_map(fused, mesh=mesh, in_specs=(spec,),
-                                 out_specs=spec, check_rep=False)(batch)
+                try:
+                    from jax import shard_map
+                    wrapped = shard_map(fused, mesh=mesh, in_specs=(spec,),
+                                        out_specs=spec, check_vma=False)
+                except (ImportError, TypeError):  # older jax
+                    from jax.experimental.shard_map import shard_map
+                    wrapped = shard_map(fused, mesh=mesh, in_specs=(spec,),
+                                        out_specs=spec, check_rep=False)
+                return wrapped(batch)
             return fused(batch)
         x = batch.astype(jnp.float32)
         if x.shape[1] != self.height or x.shape[2] != self.width:

@@ -7,12 +7,11 @@ tables score directly.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
-import numpy as np
 
 from ..core.params import ComplexParam, Param, TypeConverters
-from ..core.pipeline import Estimator, Model, Transformer
+from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
 from ..core.schema import Table, find_unused_column_name
 from ..featurize.featurize import Featurize

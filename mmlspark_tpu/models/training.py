@@ -7,8 +7,7 @@ handled by XLA from sharding annotations.  bfloat16 compute, float32 state.
 """
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,7 @@ for serving.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, List, Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

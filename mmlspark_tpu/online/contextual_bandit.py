@@ -16,7 +16,6 @@ features of the chosen action — one jitted AdaGrad scan, like learners.py.
 """
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import List, Tuple
 

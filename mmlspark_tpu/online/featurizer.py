@@ -19,7 +19,7 @@ from ..core.params import Param, TypeConverters
 from ..core.pipeline import Transformer
 from ..core.registry import register_stage
 from ..core.schema import Table
-from .hashing import FeatureHasher, murmurhash3_32
+from .hashing import FeatureHasher
 
 __all__ = ["VowpalWabbitFeaturizer", "VowpalWabbitInteractions", "VectorZipper",
            "sparse_to_padded"]

@@ -9,8 +9,6 @@ pairs are what feed the TPU learners.
 """
 from __future__ import annotations
 
-import numpy as np
-
 __all__ = ["murmurhash3_32", "hash_feature", "FeatureHasher"]
 
 _C1 = 0xCC9E2D51

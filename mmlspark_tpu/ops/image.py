@@ -10,8 +10,7 @@ gray conversion uses the BT.601 weights OpenCV uses.
 """
 from __future__ import annotations
 
-import functools
-from typing import Optional, Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

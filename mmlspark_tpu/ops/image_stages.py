@@ -15,14 +15,13 @@ one program per shape group.
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.params import Param, TypeConverters
 from ..core.pipeline import Transformer
 from ..core.registry import register_stage
 from ..core.schema import Table

@@ -7,12 +7,12 @@ precisionAtk, recallAtK, diversityAtK, maxDiversity).
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 
 from ..core.params import ComplexParam, Param, TypeConverters
-from ..core.pipeline import Estimator, Model, Transformer
+from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
 from ..core.schema import Table
 

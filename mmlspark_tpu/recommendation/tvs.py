@@ -6,7 +6,7 @@ wrapped recommender, keep the best by RankingEvaluator metric.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List
 
 import numpy as np
 

@@ -9,14 +9,14 @@ PartitionConsolidator (PartitionConsolidator.scala:22).
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from ..core.params import ComplexParam, Param, TypeConverters
-from ..core.pipeline import Estimator, Model, PipelineStage, Transformer
+from ..core.pipeline import Estimator, Model, Transformer
 from ..core.registry import register_stage
-from ..core.schema import Table, find_unused_column_name
+from ..core.schema import Table
 from ..core.shared import shared_singleton
 
 __all__ = [

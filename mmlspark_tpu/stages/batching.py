@@ -12,11 +12,11 @@ TPU feed path: MiniBatch -> device_put -> jitted forward -> FlattenBatch.
 """
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import List
 
 import numpy as np
 
-from ..core.batching import DynamicBufferedBatcher, FixedBufferedBatcher, fixed_batcher, time_interval_batcher
+from ..core.batching import FixedBufferedBatcher, time_interval_batcher
 from ..core.params import Param, TypeConverters
 from ..core.pipeline import Transformer
 from ..core.registry import register_stage

@@ -1,0 +1,34 @@
+"""The CI harness itself: lint gate + deterministic shard assignment
+(pipeline.yaml:41 scalastyle; :332-415 sharded matrix w/ flaky retry)."""
+import glob
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import ci  # noqa: E402
+
+
+def test_lint_gate_is_green():
+    assert ci.lint() == 0
+
+
+def test_shards_partition_all_test_files():
+    shards = ci.shard_files(4)
+    flat = [f for s in shards for f in s]
+    want = sorted(os.path.basename(p) for p in glob.glob(
+        os.path.join(os.path.dirname(__file__), "test_*.py")))
+    assert sorted(flat) == want          # every file exactly once
+    assert len(shards) == 4
+    assert max(len(s) for s in shards) - min(len(s) for s in shards) <= 1
+
+    # deterministic across calls/machines
+    assert ci.shard_files(4) == shards
+
+
+def test_cli_shard_listing_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "ci.py"), "lint"],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

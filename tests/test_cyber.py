@@ -3,8 +3,6 @@
 in-group access; scalers are per-partition.
 """
 import numpy as np
-import pytest
-
 from mmlspark_tpu import Table
 from mmlspark_tpu.cyber import (
     AccessAnomaly,

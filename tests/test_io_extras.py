@@ -2,7 +2,6 @@
 PowerBI writer, plot data helpers.
 """
 import json
-import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 

@@ -4,8 +4,6 @@ conditional filtering, serialization fuzzing.
 Mirrors reference core/src/test/.../nn/BallTreeTest.scala + KNNSuite.scala.
 """
 import numpy as np
-import pytest
-
 from mmlspark_tpu.core.schema import Table
 from mmlspark_tpu.nn import (
     KNN,

@@ -12,8 +12,6 @@ import threading
 import time
 
 import numpy as np
-import pytest
-
 from mmlspark_tpu.core.pipeline import LambdaTransformer
 from mmlspark_tpu.io.http.clients import send_request
 from mmlspark_tpu.io.http.schema import HTTPResponseData, to_http_request

@@ -1,0 +1,190 @@
+"""CI entry point: lint + sharded test matrix with flaky retries.
+
+Reference: the pipeline's style gate and sharded test matrix
+(pipeline.yaml:41 scalastyle; :332-415 — per-package test jobs with
+20-minute budgets and flaky-retry).  One command runs the same thing
+anywhere:
+
+    python tools/ci.py lint                 # style/correctness gate
+    python tools/ci.py test [--shards N] [--shard K] [--retries R]
+    python tools/ci.py all                  # lint + every shard
+
+Lint uses ruff when installed (configured in pyproject.toml); this image
+bakes no linter, so a built-in AST linter covers the highest-signal
+checks (syntax, unused imports, bare except, mutable default args) with
+zero dependencies.
+
+Sharding assigns test FILES round-robin over sorted order, so shard
+membership is deterministic across machines; a failed shard reruns once
+(--retries) and only an honest second failure fails the job.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import glob
+import os
+import shutil
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LINT_TARGETS = ("mmlspark_tpu", "tests", "tools", "examples",
+                "bench.py", "__graft_entry__.py")
+
+
+# ---------------------------------------------------------------- lint
+
+def _py_files():
+    out = []
+    for t in LINT_TARGETS:
+        p = os.path.join(ROOT, t)
+        if os.path.isfile(p):
+            out.append(p)
+        else:
+            out.extend(sorted(glob.glob(os.path.join(p, "**", "*.py"),
+                                        recursive=True)))
+    return out
+
+
+class _Lint(ast.NodeVisitor):
+    """Minimal high-signal linter: unused imports (F401), bare except
+    (E722), mutable default args (B006)."""
+
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path
+        self.problems: list = []
+        self.imported: dict = {}  # name -> lineno
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported[a.asname or a.name] = node.lineno
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.problems.append(
+                (node.lineno, "E722 bare 'except:' — name the exception"))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node, _async=False):
+        for d in node.args.defaults + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.problems.append(
+                    (d.lineno, "B006 mutable default argument"))
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def finish(self):
+        import re
+
+        # an import is "used" if its name occurs as a whole word anywhere
+        # else in the source (attribute chains, decorators, __all__
+        # strings, doctests); word boundaries so 'np' never matches 'jnp'
+        is_init = os.path.basename(self.path) == "__init__.py"
+        lines = self.src.splitlines()
+        for name, lineno in self.imported.items():
+            if is_init or name.startswith("_"):
+                continue  # re-export surface / deliberate side-effect
+            pat = re.compile(r"\b%s\b" % re.escape(name))
+            uses = len(pat.findall(self.src))
+            uses -= len(pat.findall(lines[lineno - 1]))
+            if uses <= 0:
+                self.problems.append(
+                    (lineno, f"F401 '{name}' imported but unused"))
+        return sorted(self.problems)
+
+
+def lint() -> int:
+    if shutil.which("ruff"):
+        return subprocess.call(["ruff", "check", ROOT])
+    failures = 0
+    for path in _py_files():
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            print(f"{path}:{e.lineno}: E999 {e.msg}")
+            failures += 1
+            continue
+        linter = _Lint(src, path)
+        linter.visit(tree)
+        for lineno, msg in linter.finish():
+            print(f"{os.path.relpath(path, ROOT)}:{lineno}: {msg}")
+            failures += 1
+    if failures:
+        print(f"lint: {failures} problem(s)")
+    else:
+        print(f"lint: {len(_py_files())} files clean (builtin AST linter)")
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------- test
+
+def shard_files(n_shards: int):
+    """Deterministic round-robin assignment of test files to shards."""
+    files = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
+    shards = [[] for _ in range(n_shards)]
+    for i, f in enumerate(files):
+        shards[i % n_shards].append(f)
+    return shards
+
+
+def run_shard(files, retries: int, timeout_s: int) -> bool:
+    cmd = [sys.executable, "-m", "pytest", "-x", "-q"] + [
+        os.path.join("tests", f) for f in files]
+    for attempt in range(retries + 1):
+        note = f" (retry {attempt})" if attempt else ""
+        print(f"== shard: {len(files)} files{note}")
+        try:
+            rc = subprocess.call(cmd, cwd=ROOT, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            print(f"shard timed out after {timeout_s}s")
+            rc = 1
+        if rc == 0:
+            return True
+    return False
+
+
+def test(n_shards: int, shard: int, retries: int, timeout_s: int) -> int:
+    shards = shard_files(n_shards)
+    run = ([shards[shard]] if shard >= 0 else shards)
+    ok = all(run_shard(files, retries, timeout_s) for files in run if files)
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("command", choices=["lint", "test", "all"])
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--shard", type=int, default=-1,
+                    help="run only this shard index (CI matrix job)")
+    ap.add_argument("--retries", type=int, default=1)
+    ap.add_argument("--timeout", type=int, default=1200,
+                    help="per-shard budget, seconds (pipeline.yaml's 20min)")
+    args = ap.parse_args(argv)
+    if args.command == "lint":
+        return lint()
+    if args.command == "test":
+        return test(args.shards, args.shard, args.retries, args.timeout)
+    rc = lint()
+    return rc or test(args.shards, args.shard, args.retries, args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

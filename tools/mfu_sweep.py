@@ -1,0 +1,121 @@
+"""MFU campaign driver: sweep batch size / dtype / XLA flags on the real
+chip and print one JSON line per config.
+
+XLA flags only apply at backend init, so every config runs in a fresh
+subprocess.  Usage (tunnel must be up):
+
+    python tools/mfu_sweep.py              # the standard sweep
+    python tools/mfu_sweep.py --quick      # batch sweep only
+
+Results feed docs/performance.md's roofline section; tools/roofline.py
+computes the analytic ceiling these numbers are judged against.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    # (tag, batch, extra XLA flags)
+    ("b128", 128, ""),
+    ("b256", 256, ""),
+    ("b512", 512, ""),
+    ("b256-latency-hiding", 256,
+     "--xla_tpu_enable_latency_hiding_scheduler=true"),
+    ("b256-async-all", 256,
+     "--xla_enable_async_all_gather=true"),
+]
+QUICK = {"b128", "b256", "b512"}
+
+
+def child(batch: int) -> int:
+    """Runs in the measurement subprocess: jitted ResNet-50 bf16 forward."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, ROOT)
+    from bench import _chip_peak_flops
+    from mmlspark_tpu.models.bundle import FlaxBundle
+
+    bundle = FlaxBundle("resnet50", {"num_classes": 1000},
+                        input_shape=(224, 224, 3))
+    dev_vars = jax.device_put(
+        jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16), bundle.variables))
+
+    def forward(v, x):
+        return bundle.apply(v, x)["pool"]
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16)
+    compiled = jax.jit(forward).lower(dev_vars, x).compile()
+    cost = compiled.cost_analysis()
+    flops = float(cost.get("flops", 0.0))
+    bytes_acc = float(cost.get("bytes accessed", 0.0))
+    jax.block_until_ready(compiled(dev_vars, x))
+    best = None
+    iters = 10
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = compiled(dev_vars, x)
+        jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    kind = jax.devices()[0].device_kind
+    peak = _chip_peak_flops()
+    print(json.dumps({
+        "batch": batch,
+        "ips": round(iters * batch / best, 1),
+        "ms_per_batch": round(1000 * best / iters, 2),
+        "mfu": round(iters * flops / best / peak, 4) if peak else None,
+        "xla_flops": flops,
+        "xla_bytes": bytes_acc,
+        "arith_intensity": round(flops / bytes_acc, 1) if bytes_acc else None,
+        "device": kind,
+    }))
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--child", type=int, default=None)
+    args = ap.parse_args()
+    if args.child is not None:
+        return child(args.child)
+    for tag, batch, flags in CONFIGS:
+        if args.quick and tag not in QUICK:
+            continue
+        env = dict(os.environ)
+        if flags:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", str(batch)],
+                env=env, capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"tag": tag, "error": "timeout"}))
+            continue
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        try:
+            rec = json.loads(line)
+            rec["tag"] = tag
+            if flags:
+                rec["xla_flags"] = flags
+        except json.JSONDecodeError:
+            rec = {"tag": tag, "error": (proc.stderr or "no output")[-300:]}
+        print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Analytic roofline for the ResNet-50 bf16 forward on one TPU chip.
+
+Answers the VERDICT's question: what MFU is ResNet-50 inference on a v5e
+physically capable of, layer group by layer group?  Each conv is either
+MXU-bound (FLOPs / peak) or HBM-bound (activation+weight traffic / BW);
+its minimum runtime is the max of the two.  Elementwise ops (BN, relu,
+add) are pure HBM traffic XLA fuses into the convs' epilogues — modeled
+as extra bytes, zero extra FLOPs.
+
+    python tools/roofline.py [--batch 256] [--peak-tflops 197] [--hbm-gbs 819]
+
+Prints per-group and whole-model bounds; the "mfu_ceiling" line is the
+number measured MFU should be compared against (NOT 1.0 — the stem and
+early stages are bandwidth-bound at any batch size).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+# ResNet-50 conv inventory: (name, h_out, w_out, c_in, c_out, k, stride, n)
+# n = how many identical convs across the net (bottleneck repeats).
+# Sizes for 224x224 input.
+LAYERS = [
+    ("stem7x7", 112, 112, 3, 64, 7, 2, 1),
+    # stage 1 (56x56): 3 bottlenecks 64->64->256
+    ("s1_proj", 56, 56, 64, 256, 1, 1, 1),
+    ("s1_c1", 56, 56, 64, 64, 1, 1, 1),      # first block reads 64ch
+    ("s1_c1r", 56, 56, 256, 64, 1, 1, 2),
+    ("s1_c2", 56, 56, 64, 64, 3, 1, 3),
+    ("s1_c3", 56, 56, 64, 256, 1, 1, 3),
+    # stage 2 (28x28): 4 bottlenecks 128
+    ("s2_proj", 28, 28, 256, 512, 1, 2, 1),
+    ("s2_c1", 28, 28, 256, 128, 1, 1, 1),    # stride handled approx
+    ("s2_c1r", 28, 28, 512, 128, 1, 1, 3),
+    ("s2_c2", 28, 28, 128, 128, 3, 1, 4),
+    ("s2_c3", 28, 28, 128, 512, 1, 1, 4),
+    # stage 3 (14x14): 6 bottlenecks 256
+    ("s3_proj", 14, 14, 512, 1024, 1, 2, 1),
+    ("s3_c1", 14, 14, 512, 256, 1, 1, 1),
+    ("s3_c1r", 14, 14, 1024, 256, 1, 1, 5),
+    ("s3_c2", 14, 14, 256, 256, 3, 1, 6),
+    ("s3_c3", 14, 14, 256, 1024, 1, 1, 6),
+    # stage 4 (7x7): 3 bottlenecks 512
+    ("s4_proj", 7, 7, 1024, 2048, 1, 2, 1),
+    ("s4_c1", 7, 7, 1024, 512, 1, 1, 1),
+    ("s4_c1r", 7, 7, 2048, 512, 1, 1, 2),
+    ("s4_c2", 7, 7, 512, 512, 3, 1, 3),
+    ("s4_c3", 7, 7, 512, 2048, 1, 1, 3),
+]
+BYTES = 2  # bfloat16
+
+
+def analyze(batch: int, peak_flops: float, hbm_bw: float):
+    rows = []
+    tot_t = tot_flops = 0.0
+    for name, ho, wo, cin, cout, k, stride, n in LAYERS:
+        hi, wi = ho * stride, wo * stride
+        flops = 2.0 * batch * ho * wo * cin * cout * k * k * n
+        # traffic: read input act + weights, write output act (+ one fused
+        # elementwise read-modify-write epilogue ~ output again)
+        act_in = batch * hi * wi * cin * BYTES * n
+        act_out = batch * ho * wo * cout * BYTES * n
+        weights = cin * cout * k * k * BYTES * n
+        bytes_ = act_in + 2 * act_out + weights
+        t_mxu = flops / peak_flops
+        t_hbm = bytes_ / hbm_bw
+        t = max(t_mxu, t_hbm)
+        rows.append({
+            "layer": name, "flops_G": round(flops / 1e9, 1),
+            "bytes_M": round(bytes_ / 1e6, 1),
+            "bound": "mxu" if t_mxu >= t_hbm else "hbm",
+            "t_us": round(t * 1e6, 1),
+            "mxu_util_at_bound": round(t_mxu / t, 3),
+        })
+        tot_t += t
+        tot_flops += flops
+    mfu_ceiling = tot_flops / peak_flops / tot_t
+    return rows, {
+        "batch": batch,
+        "total_flops_G": round(tot_flops / 1e9, 1),
+        "min_time_ms": round(tot_t * 1e3, 2),
+        "ips_ceiling": round(batch / tot_t, 0),
+        "mfu_ceiling": round(mfu_ceiling, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--hbm-gbs", type=float, default=819.0)
+    args = ap.parse_args()
+    rows, summary = analyze(args.batch, args.peak_tflops * 1e12,
+                            args.hbm_gbs * 1e9)
+    for r in rows:
+        print(json.dumps(r))
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
