@@ -41,9 +41,10 @@ class _Block(nn.Module):
         qkv = nn.Dense(3 * e, use_bias=False, dtype=self.dtype,
                        name="qkv")(y)
         q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
-        # attention accumulates in f32 (online softmax) regardless of dtype
-        a = self.attn_fn(q.astype(jnp.float32), k.astype(jnp.float32),
-                         v.astype(jnp.float32))
+        # q/k/v stay at model dtype so the attention matmuls hit the MXU
+        # at full bf16 rate; the attention fns accumulate in f32 via
+        # preferred_element_type and keep softmax statistics f32
+        a = self.attn_fn(q, k, v)
         a = a.astype(self.dtype).reshape(b, s, e)
         x = x + nn.Dense(e, use_bias=False, dtype=self.dtype,
                          name="proj")(a)
@@ -64,7 +65,12 @@ class TransformerLM(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     # None -> dense causal attention; or any (q, k, v) -> out with
-    # (B, S, H, D) shapes, e.g. partial(ring_attention, mesh=m, causal=True)
+    # (B, S, H, D) shapes, e.g. partial(ring_attention, mesh=m, causal=True).
+    # PRECISION CONTRACT: q/k/v arrive at the MODEL dtype (bf16 when
+    # dtype=bf16) so attention matmuls hit the MXU at full rate — the fn
+    # must accumulate in f32 itself (preferred_element_type + f32 softmax
+    # stats, as full_attention/ring_attention/ulysses_attention all do)
+    # and should return f32.
     attn_fn: Optional[Callable] = None
     layer_names = ["logits", "pool", "hidden", "embed"]
     input_dtype = jnp.int32  # token ids (FlaxBundle auto-init dummy dtype)

@@ -29,15 +29,24 @@ __all__ = ["full_attention", "ring_attention", "ulysses_attention"]
 
 
 def full_attention(q, k, v, causal: bool = False):
-    """Reference dense attention.  q,k,v: (B, S, H, D) -> (B, S, H, D)."""
+    """Reference dense attention.  q,k,v: (B, S, H, D) -> (B, S, H, D) f32.
+
+    MXU-friendly mixed precision: the two matmuls run at the INPUT dtype
+    (bf16 inputs hit the systolic array at full rate) with f32
+    accumulation (`preferred_element_type`); softmax statistics stay f32.
+    f32 inputs are bit-identical to the previous formulation.
+    """
     d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((sq, sk), bool))
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)
 
 
 def _block_accumulate(q, k_blk, v_blk, o, m, l, q_off, k_off, causal: bool):
@@ -48,7 +57,9 @@ def _block_accumulate(q, k_blk, v_blk, o, m, l, q_off, k_off, causal: bool):
     o: (B, Sq, H, D) unnormalized; m,l: (B, H, Sq) running max / normalizer.
     """
     d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) / jnp.sqrt(jnp.float32(d))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
     if causal:
         qpos = q_off + jnp.arange(q.shape[1])
         kpos = k_off + jnp.arange(k_blk.shape[1])
@@ -63,7 +74,8 @@ def _block_accumulate(q, k_blk, v_blk, o, m, l, q_off, k_off, causal: bool):
     p = jnp.where(jnp.isfinite(s), p, 0.0)
     l_new = l * corr + jnp.sum(p, axis=-1)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v_blk
+        "bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
     )
     return o_new, m_new, l_new
 

@@ -43,6 +43,22 @@ def test_ring_attention_matches_full(mesh, qkv):
                                atol=2e-5, rtol=2e-5)
 
 
+def test_bf16_attention_mixed_precision(mesh, qkv):
+    """bf16 q/k/v (the MXU fast path: bf16 matmuls, f32 accumulation +
+    softmax stats) must track the f32 result, and ring must track dense
+    under the SAME quantization."""
+    q, k, v = qkv
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref32 = full_attention(q, k, v, causal=True)
+    dense16 = full_attention(qb, kb, vb, causal=True)
+    assert dense16.dtype == jnp.float32  # f32 accumulation preserved
+    np.testing.assert_allclose(np.asarray(dense16), np.asarray(ref32),
+                               atol=0.05, rtol=0.05)
+    ring16 = ring_attention(qb, kb, vb, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ring16), np.asarray(dense16),
+                               atol=0.02, rtol=0.02)
+
+
 def test_ring_attention_causal(mesh, qkv):
     q, k, v = qkv
     expected = full_attention(q, k, v, causal=True)
