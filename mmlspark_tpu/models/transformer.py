@@ -26,6 +26,12 @@ import jax.numpy as jnp
 __all__ = ["TransformerLM", "transformer_lm"]
 
 
+def _single_tpu() -> bool:
+    """Default-attention dispatch predicate (separable so tests can force
+    the Pallas branch on the CPU backend via interpret mode)."""
+    return jax.default_backend() == "tpu" and jax.device_count() == 1
+
+
 class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int
@@ -79,8 +85,19 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         from ..parallel.ring_attention import full_attention
 
-        attn = self.attn_fn or (
-            lambda q, k, v: full_attention(q, k, v, causal=True))
+        if self.attn_fn is not None:
+            attn = self.attn_fn
+        elif _single_tpu():
+            # default dense attention rides the Pallas kernel on a single
+            # TPU: VMEM-resident scores, XLA-recompute backward (exact).
+            # Multi-device programs keep XLA dense (a Pallas custom call
+            # is not GSPMD-partitionable) — sequence-parallel users pass
+            # ring/ulysses attn_fns, which shard_map themselves.
+            from ..ops.attention_kernels import fused_attention
+
+            attn = lambda q, k, v: fused_attention(q, k, v, True)
+        else:
+            attn = lambda q, k, v: full_attention(q, k, v, causal=True)
         taps: Dict[str, jnp.ndarray] = {}
         b, s = tokens.shape
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,

@@ -1,0 +1,170 @@
+"""Pallas TPU kernel for the attention hot path.
+
+The dense attention in parallel/ring_attention.full_attention materializes
+the [B, H, S, S] score tensor in HBM — at S=4096, bf16, that is 32MB per
+(batch, head) of pure bandwidth.  This kernel keeps each query block's
+scores VMEM-resident: one HBM read of Q/K/V and one write of O per block,
+the flash-attention traffic shape (Liu et al. ring attention's intra-chip
+sibling; reference has no analog — its deepest attention is CNTK-era).
+
+Mosaic-friendly formulation (same playbook as pallas_kernels.py):
+  - Q/K/V reshaped OUTSIDE the kernel to [B*H, S, D] (no in-kernel
+    reshapes), head_dim padded to a 128 multiple (lane tiling).
+  - grid = (B*H, S / block_q); each step loads one [block_q, D] Q block
+    plus that (b,h)'s whole [S, D] K/V (fits VMEM for S <= ~4k bf16 —
+    enforced by a budget check; larger S falls back to XLA).
+  - scores/softmax in f32 on the [block_q, S] block; both matmuls via
+    dot_general with f32 accumulation; causal mask from broadcasted_iota
+    (2D iota is Mosaic-legal, 1D is not).
+
+Training: fused_attention carries a custom VJP whose BACKWARD is the
+plain-XLA composition (recompute) — kernel-fast forward, exact XLA
+gradients, no second kernel to validate.  Forward-only callers (serving,
+featurization) never touch the backward path.
+
+On CPU the kernel runs interpret=True (tests/CI); on TPU it compiles to
+Mosaic.  tests/test_attention_kernels.py holds the parity suite; the
+on-hardware compile check rides the same real-TPU gate as the image
+kernels.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_kernels import (
+    PALLAS_IMAGE_VMEM_BUDGET,
+    _interpret,
+    _pad_up,
+    pallas_available,
+)
+
+__all__ = ["fused_attention", "attention_fits_vmem"]
+
+_BLOCK_Q = 128
+_LANE = 128
+
+
+def attention_fits_vmem(s: int, d: int, itemsize: int = 2,
+                        block_q: int = _BLOCK_Q) -> bool:
+    """Per-grid-step VMEM estimate: K+V at input dtype, Q block, f32
+    scores + probabilities, f32 O block."""
+    d_p = _pad_up(d, _LANE)
+    staged = (2 * s * d_p * itemsize          # K + V
+              + block_q * d_p * itemsize      # Q block
+              + 2 * block_q * s * 4           # scores + probs (f32)
+              + block_q * d_p * 4)            # O accumulator
+    return staged <= PALLAS_IMAGE_VMEM_BUDGET
+
+
+@partial(jax.jit, static_argnames=("causal", "scale"))
+def _attention_pallas(q, k, v, causal: bool, scale: float):
+    """q,k,v: [BH, S, D_padded] (D padded to a lane multiple) -> [BH, S,
+    D_padded] f32.  `scale` is 1/sqrt(TRUE head dim) — the padded D must
+    not leak into the softmax temperature."""
+    from jax.experimental import pallas as pl
+
+    bh, s, d = q.shape
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, *, scale):
+        qb = q_ref[0]                       # [block_q, D]
+        kb = k_ref[0]                       # [S, D]
+        vb = v_ref[0]
+        sc = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [block_q, S]
+        if causal:
+            qi = pl.program_id(1)
+            rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+            mask = (qi * q_ref.shape[1] + rows) >= cols
+            sc = jnp.where(mask, sc, -jnp.inf)
+        m = jnp.max(sc, axis=-1, keepdims=True)
+        p = jnp.exp(sc - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[0] = o / l
+
+    block_q = min(_BLOCK_Q, s)
+    return pl.pallas_call(
+        partial(kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def _xla_attention(q, k, v, causal: bool):
+    from ..parallel.ring_attention import full_attention
+
+    return full_attention(q, k, v, causal=causal)
+
+
+def _kernel_ok(q) -> bool:
+    b, s, h, d = q.shape
+    if not pallas_available():
+        return False
+    if s % min(_BLOCK_Q, s) or s % 8 or s < 8:
+        return False
+    # lane padding below d=64 (4x+ wasted MXU work and padded HBM copies)
+    # makes the kernel a net loss vs XLA dense — keep small heads on XLA
+    if d < 64:
+        return False
+    return attention_fits_vmem(s, d, q.dtype.itemsize)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_attention(q, k, v, causal: bool = True):
+    """Drop-in for full_attention: (B, S, H, D) -> (B, S, H, D) f32.
+
+    VMEM-resident scores on TPU via Pallas (interpret mode elsewhere);
+    falls back to the XLA composition when the shape can't take the
+    kernel (S not a block multiple, K/V too large for VMEM).  Scale
+    uses the TRUE head dim even when D pads to the 128 lane.
+    Differentiable: the backward pass is the exact XLA recompute.
+    """
+    return _fused_attention_fwd(q, k, v, causal)[0]
+
+
+def _run_kernel(q, k, v, causal: bool):
+    b, s, h, d = q.shape
+    d_p = _pad_up(d, _LANE)
+
+    def to_bhsd(x):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+        if d_p != d:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_p - d)))
+        return x
+
+    o = _attention_pallas(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal,
+                          1.0 / float(d) ** 0.5)
+    o = o[..., :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return o
+
+
+def _fused_attention_fwd(q, k, v, causal):
+    if _kernel_ok(q):
+        out = _run_kernel(q, k, v, causal)
+    else:
+        out = _xla_attention(q, k, v, causal)
+    return out, (q, k, v)
+
+
+def _fused_attention_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+fused_attention.defvjp(_fused_attention_fwd, _fused_attention_bwd)
