@@ -10,12 +10,17 @@ sibling; reference has no analog — its deepest attention is CNTK-era).
 Mosaic-friendly formulation (same playbook as pallas_kernels.py):
   - Q/K/V reshaped OUTSIDE the kernel to [B*H, S, D] (no in-kernel
     reshapes), head_dim padded to a 128 multiple (lane tiling).
-  - grid = (B*H, S / block_q); each step loads one [block_q, D] Q block
-    plus that (b,h)'s whole [S, D] K/V (fits VMEM for S <= ~4k bf16 —
-    enforced by a budget check; larger S falls back to XLA).
-  - scores/softmax in f32 on the [block_q, S] block; both matmuls via
-    dot_general with f32 accumulation; causal mask from broadcasted_iota
-    (2D iota is Mosaic-legal, 1D is not).
+  - grid = (B*H, S/block_q, S/block_k), K innermost: K/V blocks STREAM
+    through VMEM while running max / normalizer / unnormalized output
+    live in VMEM scratch across the K steps (online softmax, the true
+    flash-attention recurrence) — so VMEM use is O(block_q * block_k),
+    independent of S; block_k adapts to the largest block tiling S, so
+    any 128-multiple sequence length takes the kernel.
+  - scores/softmax in f32; both matmuls via dot_general with f32
+    accumulation; causal mask from broadcasted_iota (2D iota is
+    Mosaic-legal, 1D is not); the m/l running statistics are stored
+    lane-broadcast as [block_q, 128] blocks (a bare [block_q] vector
+    is not a legal Mosaic tile).
 
 Training: fused_attention carries a custom VJP whose BACKWARD is the
 plain-XLA composition (recompute) — kernel-fast forward, exact XLA
@@ -44,18 +49,32 @@ from .pallas_kernels import (
 __all__ = ["fused_attention", "attention_fits_vmem"]
 
 _BLOCK_Q = 128
+_BLOCK_K = 512
 _LANE = 128
+_NEG_INF = -1e30  # finite stand-in: -inf arithmetic is fragile on Mosaic
+
+
+def _pick_block_k(s: int) -> int:
+    """Largest K block that tiles s — any 128-multiple S gets a kernel."""
+    for blk in (_BLOCK_K, 256, 128):
+        if s >= blk and s % blk == 0:
+            return blk
+    return s  # s < 128: single block (s itself must divide by 8)
 
 
 def attention_fits_vmem(s: int, d: int, itemsize: int = 2,
-                        block_q: int = _BLOCK_Q) -> bool:
-    """Per-grid-step VMEM estimate: K+V at input dtype, Q block, f32
-    scores + probabilities, f32 O block."""
+                        block_q: int = _BLOCK_Q,
+                        block_k: int = _BLOCK_K) -> bool:
+    """Per-grid-step VMEM estimate — O(block_q * block_k), NOT O(S):
+    K/V blocks stream while o/m/l scratch persists."""
     d_p = _pad_up(d, _LANE)
-    staged = (2 * s * d_p * itemsize          # K + V
+    block_k = _pick_block_k(s) if block_k == _BLOCK_K else min(block_k, s)
+    block_q = min(block_q, s)
+    staged = (2 * block_k * d_p * itemsize    # K + V blocks
               + block_q * d_p * itemsize      # Q block
-              + 2 * block_q * s * 4           # scores + probs (f32)
-              + block_q * d_p * 4)            # O accumulator
+              + 2 * block_q * block_k * 4     # scores + probs (f32)
+              + block_q * d_p * 4             # O scratch
+              + 2 * block_q * _LANE * 4)      # m / l scratch
     return staged <= PALLAS_IMAGE_VMEM_BUDGET
 
 
@@ -65,41 +84,74 @@ def _attention_pallas(q, k, v, causal: bool, scale: float):
     D_padded] f32.  `scale` is 1/sqrt(TRUE head dim) — the padded D must
     not leak into the softmax temperature."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
-
-    def kernel(q_ref, k_ref, v_ref, o_ref, *, scale):
-        qb = q_ref[0]                       # [block_q, D]
-        kb = k_ref[0]                       # [S, D]
-        vb = v_ref[0]
-        sc = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [block_q, S]
-        if causal:
-            qi = pl.program_id(1)
-            rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-            mask = (qi * q_ref.shape[1] + rows) >= cols
-            sc = jnp.where(mask, sc, -jnp.inf)
-        m = jnp.max(sc, axis=-1, keepdims=True)
-        p = jnp.exp(sc - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        o = jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        o_ref[0] = o / l
-
     block_q = min(_BLOCK_Q, s)
+    block_k = _pick_block_k(s)
+    n_k = s // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *, scale):
+        ki = pl.program_id(2)
+        qi = pl.program_id(1)
+
+        @pl.when(ki == 0)
+        def _init():
+            o_acc[...] = jnp.zeros_like(o_acc)
+            m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
+            l_acc[...] = jnp.zeros_like(l_acc)
+
+        # causal: K blocks entirely above the diagonal are pure no-op work
+        # (up to ~half the grid at long S) — skip both matmuls for them
+        visible = ((qi * block_q + block_q - 1 >= ki * block_k)
+                   if causal else (ki >= 0))
+
+        @pl.when(visible)
+        def _update():
+            qb = q_ref[0]                    # [block_q, D]
+            kb = k_ref[0]                    # [block_k, D]
+            vb = v_ref[0]
+            sc = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+                mask = (qi * block_q + rows) >= (ki * block_k + cols)
+                sc = jnp.where(mask, sc, _NEG_INF)
+            # online softmax: m/l live lane-broadcast in [bq, LANE] scratch
+            m_prev = m_acc[:, :1]                          # [block_q, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+            corr = jnp.exp(m_prev - m_new)
+            p = jnp.exp(sc - m_new)                        # [bq, bk] f32
+            l_new = l_acc[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            o_acc[...] = o_acc[...] * corr + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_acc[...] = jnp.broadcast_to(m_new, m_acc.shape)
+            l_acc[...] = jnp.broadcast_to(l_new, l_acc.shape)
+
+        @pl.when(ki == n_k - 1)
+        def _finish():
+            # fully-masked rows (possible only with non-causal all-pad
+            # inputs) keep l=0; guard the divide
+            o_ref[0] = o_acc[...] / jnp.maximum(l_acc[:, :1], 1e-20)
+
     return pl.pallas_call(
         partial(kernel, scale=scale),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
-        grid=(bh, s // block_q),
+        grid=(bh, s // block_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+        ],
         interpret=_interpret(),
     )(q, k, v)
 
@@ -114,7 +166,7 @@ def _kernel_ok(q) -> bool:
     b, s, h, d = q.shape
     if not pallas_available():
         return False
-    if s % min(_BLOCK_Q, s) or s % 8 or s < 8:
+    if s % min(_BLOCK_Q, s) or s % _pick_block_k(s) or s % 8 or s < 8:
         return False
     # lane padding below d=64 (4x+ wasted MXU work and padded HBM copies)
     # makes the kernel a net loss vs XLA dense — keep small heads on XLA
@@ -129,9 +181,10 @@ def fused_attention(q, k, v, causal: bool = True):
 
     VMEM-resident scores on TPU via Pallas (interpret mode elsewhere);
     falls back to the XLA composition when the shape can't take the
-    kernel (S not a block multiple, K/V too large for VMEM).  Scale
-    uses the TRUE head dim even when D pads to the 128 lane.
-    Differentiable: the backward pass is the exact XLA recompute.
+    kernel (S not a 128-multiple, or head dim < 64 where lane padding
+    wastes the MXU).  Scale uses the TRUE head dim even when D pads to
+    the 128 lane.  Differentiable: the backward is the exact XLA
+    recompute.
     """
     return _fused_attention_fwd(q, k, v, causal)[0]
 
