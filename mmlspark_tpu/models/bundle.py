@@ -92,6 +92,11 @@ class FlaxBundle(ModelBundle):
                 {"params": jax.random.PRNGKey(seed)},
                 jnp.zeros((1, *self.input_shape), in_dtype),
             )
+            # drop the transformer's init-time sown K/V (a per-call
+            # intermediate, not weights); caller-supplied variables pass
+            # through untouched — their collections are their business
+            variables = {c: v for c, v in dict(variables).items()
+                         if c != "kvcache"}
         self._variables = _to_numpy(variables)
         if layer_names is None:
             layer_names = getattr(self.module, "layer_names", None) or self._infer_layer_names()

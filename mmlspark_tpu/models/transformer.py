@@ -39,7 +39,14 @@ class _Block(nn.Module):
     attn_fn: Callable
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, pos=None):
+        """cache=None: full causal attention over x (train/score path).
+
+        cache=(k_cache, v_cache) [B, max_len, H, D] with scalar `pos`:
+        single-token decode — x is [B, 1, E]; this token's K/V is written
+        at `pos` (lax.dynamic_update_slice keeps shapes static) and the
+        query attends over cache positions <= pos.  Returns (out, cache).
+        """
         b, s, e = x.shape
         h = self.num_heads
         d = e // h
@@ -47,17 +54,41 @@ class _Block(nn.Module):
         qkv = nn.Dense(3 * e, use_bias=False, dtype=self.dtype,
                        name="qkv")(y)
         q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
-        # q/k/v stay at model dtype so the attention matmuls hit the MXU
-        # at full bf16 rate; the attention fns accumulate in f32 via
-        # preferred_element_type and keep softmax statistics f32
-        a = self.attn_fn(q, k, v)
+        if cache is None:
+            # expose this layer's K/V to generation prefill (a no-op
+            # unless the caller asked for the 'kvcache' collection)
+            self.sow("kvcache", "k", k)
+            self.sow("kvcache", "v", v)
+            # q/k/v stay at model dtype so the attention matmuls hit the
+            # MXU at full bf16 rate; the attention fns accumulate in f32
+            # via preferred_element_type with f32 softmax statistics
+            a = self.attn_fn(q, k, v)
+        else:
+            k_cache, v_cache = cache
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+            cache = (k_cache, v_cache)
+            # one query over the whole (static-length) cache, masked to
+            # positions <= pos: a [1, max_len] matmul per head — small,
+            # static, jit-friendly
+            sc = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                            preferred_element_type=jnp.float32)
+            sc = sc / jnp.sqrt(jnp.float32(d))
+            valid = jnp.arange(k_cache.shape[1]) <= pos
+            sc = jnp.where(valid[None, None, None, :], sc, -jnp.inf)
+            p = jax.nn.softmax(sc, axis=-1)
+            a = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype),
+                           v_cache, preferred_element_type=jnp.float32)
         a = a.astype(self.dtype).reshape(b, s, e)
         x = x + nn.Dense(e, use_bias=False, dtype=self.dtype,
                          name="proj")(a)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="mlp_in")(y)
         y = nn.gelu(y)
-        return x + nn.Dense(e, dtype=self.dtype, name="mlp_out")(y)
+        out = x + nn.Dense(e, dtype=self.dtype, name="mlp_out")(y)
+        return out if cache is None else (out, cache)
 
 
 class TransformerLM(nn.Module):
@@ -116,6 +147,29 @@ class TransformerLM(nn.Module):
                           name="head")(x).astype(jnp.float32)
         taps["logits"] = logits
         return logits, taps
+
+    @nn.compact
+    def decode_step(self, token, cache, pos):
+        """One autoregressive step: token [B, 1] int32 at position `pos`
+        attends over the per-layer KV cache (written in place at `pos`).
+        Returns (logits [B, 1, V] f32, new_cache).  Parameter names/shapes
+        are identical to __call__, so one set of trained weights serves
+        both paths (models/generation.py drives this under lax.scan)."""
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
+                     name="tok_embed")(token)
+        x = x + nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
+                         name="pos_embed")(pos[None] if jnp.ndim(pos) == 0
+                                           else pos)[None]
+        new_cache = []
+        for i in range(self.num_layers):
+            x, layer_cache = _Block(
+                self.num_heads, self.mlp_ratio, self.dtype,
+                attn_fn=None, name=f"block{i}")(x, cache=cache[i], pos=pos)
+            new_cache.append(layer_cache)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          name="head")(x).astype(jnp.float32)
+        return logits, tuple(new_cache)
 
 
 def transformer_lm(vocab_size=1024, embed_dim=128, num_layers=2, num_heads=4,

@@ -166,7 +166,7 @@ def _kernel_ok(q) -> bool:
     b, s, h, d = q.shape
     if not pallas_available():
         return False
-    if s % min(_BLOCK_Q, s) or s % _pick_block_k(s) or s % 8 or s < 8:
+    if s % min(_BLOCK_Q, s) or s % 8 or s < 8:
         return False
     # lane padding below d=64 (4x+ wasted MXU work and padded HBM copies)
     # makes the kernel a net loss vs XLA dense — keep small heads on XLA
