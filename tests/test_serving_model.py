@@ -136,6 +136,7 @@ def test_language_model_served_with_generation():
             t.start()
         for t in threads:
             t.join(timeout=30)
+            assert not t.is_alive(), "serving request hung"
         assert got["a"] == comp            # same prompt -> same result
         assert got["b"][:2] == [5, 9] and len(got["b"]) == 8
         assert got["c"][:3] == [2, 6, 5] and len(got["c"]) == 9
