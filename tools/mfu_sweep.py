@@ -113,8 +113,11 @@ def attn_child() -> int:
     import numpy as np
 
     sys.path.insert(0, ROOT)
+    from mmlspark_tpu.ops import attention_kernels as ak
     from mmlspark_tpu.ops.attention_kernels import fused_attention
     from mmlspark_tpu.parallel.ring_attention import full_attention
+
+    backend = jax.default_backend()
 
     rng = np.random.default_rng(0)
     failures = 0
@@ -127,7 +130,15 @@ def attn_child() -> int:
                    for _ in range(3))
         fns = {"pallas": jax.jit(lambda q, k, v: fused_attention(q, k, v, True)),
                "xla": jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))}
-        rec = {"seq": s, "head_dim": d, "heads": h}
+        # record which path 'pallas' ACTUALLY takes — parity of an XLA
+        # fallback against XLA proves nothing about the Mosaic kernel
+        kernel_runs = bool(ak._kernel_ok(q))
+        rec = {"seq": s, "head_dim": d, "heads": h,
+               "backend": backend,
+               "pallas_path": ("mosaic" if kernel_runs and backend == "tpu"
+                               else "interpret" if kernel_runs
+                               else "xla-fallback"),
+               "mosaic_validated": kernel_runs and backend == "tpu"}
         outs = {}
         try:
             for name, fn in fns.items():
