@@ -298,10 +298,14 @@ INFRA_SENTINEL = "BENCH_INFRA_ERROR"
 def _is_infra_error(e: BaseException) -> bool:
     """Backend/tunnel failures, NOT app-code bugs: the jax runtime raises
     XlaRuntimeError carrying a gRPC status; generic ConnectionError etc.
-    from application code must not match."""
+    from application code must not match.  A Mosaic compile rejection is
+    OUR kernel being wrong — it also arrives as XlaRuntimeError, but it
+    is a code regression, not infra."""
+    msg = str(e)
+    if "Mosaic" in msg or "mosaic" in msg:
+        return False
     if type(e).__name__ == "XlaRuntimeError":
         return True
-    msg = str(e)
     return any(m in msg for m in (
         "DEADLINE_EXCEEDED", "UNAVAILABLE", "remote_compile",
         "Unable to initialize backend"))

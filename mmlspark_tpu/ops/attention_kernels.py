@@ -46,7 +46,7 @@ from .pallas_kernels import (
     pallas_available,
 )
 
-__all__ = ["fused_attention", "attention_fits_vmem"]
+__all__ = ["fused_attention", "attention_fits_vmem", "kernel_ok"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 512
@@ -162,7 +162,9 @@ def _xla_attention(q, k, v, causal: bool):
     return full_attention(q, k, v, causal=causal)
 
 
-def _kernel_ok(q) -> bool:
+def kernel_ok(q) -> bool:
+    """Public predicate: will fused_attention take the Pallas kernel for
+    this (B, S, H, D) array, or fall back to the XLA composition?"""
     b, s, h, d = q.shape
     if not pallas_available():
         return False
@@ -206,7 +208,7 @@ def _run_kernel(q, k, v, causal: bool):
 
 
 def _fused_attention_fwd(q, k, v, causal):
-    if _kernel_ok(q):
+    if kernel_ok(q):
         out = _run_kernel(q, k, v, causal)
     else:
         out = _xla_attention(q, k, v, causal)

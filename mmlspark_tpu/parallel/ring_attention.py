@@ -180,7 +180,13 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,
             )
 
         qg, kg, vg = scatter_heads(q_loc), scatter_heads(k_loc), scatter_heads(v_loc)
-        og = full_attention(qg, kg, vg, causal=causal)
+        # the per-device inner attention is DENSE over the full sequence —
+        # exactly the shape the Pallas flash kernel accelerates; it falls
+        # back to the XLA composition for shapes it can't take, so this
+        # composes sequence parallelism with the VMEM-resident kernel
+        from ..ops.attention_kernels import fused_attention
+
+        og = fused_attention(qg, kg, vg, causal)
         return gather_seq(og)
 
     return ulysses(q, k, v)

@@ -79,7 +79,7 @@ def test_unkernelable_shapes_fall_back_to_xla():
                   (1, 128, 2, 32)]:  # d=32: lane padding too wasteful
         q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.float32)
                    for _ in range(3))
-        assert not ak._kernel_ok(q), shape
+        assert not ak.kernel_ok(q), shape
         got = fused_attention(q, k, v, True)
         ref = full_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -105,7 +105,7 @@ def test_long_context_multiblock_parity(seq, causal):
     rng = np.random.default_rng(4)
     q, k, v = (jnp.asarray(rng.normal(size=(1, seq, 1, 64)), jnp.float32)
                for _ in range(3))
-    assert ak._kernel_ok(q)
+    assert ak.kernel_ok(q)
     got = fused_attention(q, k, v, causal)
     ref = full_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),

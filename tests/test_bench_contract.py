@@ -164,3 +164,16 @@ def test_child_code_bug_surfaces_null_not_stale(bench, capsys, monkeypatch):
     rec = _one_json_line(capsys)
     assert rec["value"] is None
     assert "AssertionError" in rec["error"]
+
+
+def test_mosaic_rejection_is_code_not_infra(bench):
+    """A Mosaic compile rejection arrives as XlaRuntimeError too — but it
+    is OUR kernel being wrong, so it must not classify as infra (it would
+    skip the LM bench's XLA-attention retry and hide behind stale)."""
+    class XlaRuntimeError(Exception):
+        pass
+
+    mosaic = XlaRuntimeError("INTERNAL: Mosaic failed to compile TPU kernel")
+    tunnel = XlaRuntimeError("UNAVAILABLE: socket closed")
+    assert not bench._is_infra_error(mosaic)
+    assert bench._is_infra_error(tunnel)

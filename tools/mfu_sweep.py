@@ -132,13 +132,15 @@ def attn_child() -> int:
                "xla": jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))}
         # record which path 'pallas' ACTUALLY takes — parity of an XLA
         # fallback against XLA proves nothing about the Mosaic kernel
-        kernel_runs = bool(ak._kernel_ok(q))
+        kernel_runs = bool(ak.kernel_ok(q))
         rec = {"seq": s, "head_dim": d, "heads": h,
                "backend": backend,
                "pallas_path": ("mosaic" if kernel_runs and backend == "tpu"
                                else "interpret" if kernel_runs
                                else "xla-fallback"),
-               "mosaic_validated": kernel_runs and backend == "tpu"}
+               # set ONLY after the kernel actually compiled, ran, and
+               # matched — a thrown compile must not read as validated
+               "mosaic_validated": False}
         outs = {}
         try:
             for name, fn in fns.items():
@@ -148,6 +150,8 @@ def attn_child() -> int:
             rec["max_abs_diff"] = round(err, 5)
             # a recorded sweep IS the validation evidence: enforce parity
             rec["parity_ok"] = err < 0.02
+            rec["mosaic_validated"] = (kernel_runs and backend == "tpu"
+                                       and rec["parity_ok"])
             failures += 0 if rec["parity_ok"] else 1
             rec["speedup"] = round(rec["xla_ms"] / rec["pallas_ms"], 2)
         except Exception as e:  # noqa: BLE001 — report, keep sweeping
