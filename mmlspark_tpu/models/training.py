@@ -18,8 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh import batch_sharding, default_mesh, replicated_sharding
 
 __all__ = ["TrainState", "make_train_step", "make_train_epoch",
-           "make_eval_step", "fit_epochs", "shard_params",
-           "scan_slice_steps"]
+           "make_lm_train_epoch", "make_eval_step", "fit_epochs",
+           "shard_params", "scan_slice_steps"]
 
 # device-memory budget for one scanned slice of training data; a full
 # epoch is scanned in slices of at most this many bytes so device memory
@@ -164,6 +164,49 @@ def make_train_epoch(
         epoch,
         in_shardings=(None, img_sh, lbl_sh),
         donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_lm_train_epoch(
+    model,
+    optimizer,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+):
+    """`epoch(params, opt_state, tokens) -> (params, opt_state, losses)`:
+    a whole stack of next-token minibatches ([S, B, seq] int32) as ONE
+    jitted `lax.scan` — the TransformerLM counterpart of make_train_epoch
+    (same reason: one dispatch per epoch keeps a remote/tunneled chip's
+    per-call latency out of the loop; params/optimizer stay in HBM).
+    Loss is mean next-token cross-entropy in f32."""
+    mesh = mesh or default_mesh()
+
+    def lm_step(params, opt_state, toks):
+        def loss_fn(p):
+            logits, _ = model.apply({"params": p}, toks)
+            lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            ll = jnp.take_along_axis(lp, toks[:, 1:][..., None], axis=-1)
+            return -jnp.mean(ll)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def epoch(params, opt_state, tokens):
+        def body(carry, toks):
+            params, opt_state = carry
+            params, opt_state, loss = lm_step(params, opt_state, toks)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), tokens)
+        return params, opt_state, losses
+
+    tok_sh = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(
+        epoch,
+        in_shardings=(None, None, tok_sh),
+        donate_argnums=(0, 1) if donate else (),
     )
 
 

@@ -177,3 +177,12 @@ def test_mosaic_rejection_is_code_not_infra(bench):
     tunnel = XlaRuntimeError("UNAVAILABLE: socket closed")
     assert not bench._is_infra_error(mosaic)
     assert bench._is_infra_error(tunnel)
+
+
+def test_infra_status_wins_over_mosaic_mention(bench):
+    class XlaRuntimeError(Exception):
+        pass
+
+    both = XlaRuntimeError(
+        "DEADLINE_EXCEEDED: remote_compile of mosaic kernel timed out")
+    assert bench._is_infra_error(both)
