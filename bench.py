@@ -184,10 +184,15 @@ def _measure_transformer(batch: int = 16, seq: int = 1024,
     epoch = make_lm_train_epoch(model, opt, donate=False)
     # per-step FLOPs from a ONE-step epoch: XLA's cost analysis counts a
     # scan body once regardless of trip count, so the full-epoch program
-    # would undercount by `steps`x
+    # would undercount by `steps`x.  Lowered.cost_analysis needs no
+    # backend compile — no second multi-ten-second remote compile.
     try:
-        flops_step = float(epoch.lower(params, opt_state, tokens[:1])
-                           .compile().cost_analysis()["flops"])
+        lowered = epoch.lower(params, opt_state, tokens[:1])
+        try:
+            cost = lowered.cost_analysis()
+        except Exception:  # noqa: BLE001 — older jax: compile first
+            cost = lowered.compile().cost_analysis()
+        flops_step = float(cost["flops"])
     except Exception:  # noqa: BLE001
         flops_step = 0.0
     compiled = epoch.lower(params, opt_state, tokens).compile()
