@@ -119,12 +119,16 @@ def _attention_pallas(q, k, v, causal: bool, scale: float):
                 cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
                 mask = (qi * block_q + rows) >= (ki * block_k + cols)
                 sc = jnp.where(mask, sc, _NEG_INF)
-            # online softmax: m/l live lane-broadcast in [bq, LANE] scratch
-            m_prev = m_acc[:, :1]                          # [block_q, 1]
+            # online softmax: m/l live lane-broadcast in [bq, LANE]
+            # scratch.  Read via full-tile load + lane reduction (all
+            # lanes hold the same value) — a narrow [:, :1] ref slice is
+            # not a safe Mosaic tile access
+            m_prev = jnp.max(m_acc[...], axis=-1, keepdims=True)
+            l_prev = jnp.max(l_acc[...], axis=-1, keepdims=True)
             m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
             corr = jnp.exp(m_prev - m_new)
             p = jnp.exp(sc - m_new)                        # [bq, bk] f32
-            l_new = l_acc[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
             o_acc[...] = o_acc[...] * corr + jax.lax.dot_general(
                 p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -134,8 +138,10 @@ def _attention_pallas(q, k, v, causal: bool, scale: float):
         @pl.when(ki == n_k - 1)
         def _finish():
             # fully-masked rows (possible only with non-causal all-pad
-            # inputs) keep l=0; guard the divide
-            o_ref[0] = o_acc[...] / jnp.maximum(l_acc[:, :1], 1e-20)
+            # inputs) keep l=0; guard the divide.  Full-tile read + lane
+            # reduction again (lanes are equal by construction).
+            l_fin = jnp.max(l_acc[...], axis=-1, keepdims=True)
+            o_ref[0] = o_acc[...] / jnp.maximum(l_fin, 1e-20)
 
     return pl.pallas_call(
         partial(kernel, scale=scale),
