@@ -1,0 +1,34 @@
+#!/bin/bash
+# The tunnel-up evidence sweep: run the moment a real TPU is reachable.
+# Captures, in priority order (cheapest chip time first is NOT the rule —
+# round-critical evidence first is):
+#   1. bench.py           — the headline record (e2e / forward / MFU /
+#                           scanned CIFAR train / scanned LM train)
+#   2. mfu_sweep --attn   — Mosaic-validate the fused attention kernel
+#                           (parity enforced; JSON is validation evidence)
+#   3. mfu_sweep --quick  — ResNet-50 batch sweep vs the roofline ceiling
+#   4. on-TPU pytest      — clears the two real-hardware skips (fused
+#                           affine/gray Mosaic compile + attention kernel)
+# Each stage logs to tools/chip_logs/ with a timestamp; stages run even if
+# earlier ones fail (the tunnel may die mid-sweep — partial evidence beats
+# none).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p tools/chip_logs
+ts=$(date -u +%Y%m%dT%H%M%SZ)
+log() { echo "== $1 -> tools/chip_logs/${ts}-$1.log"; }
+
+log bench
+timeout 2400 python bench.py 2>&1 | tee "tools/chip_logs/${ts}-bench.log"
+
+log attn-sweep
+timeout 1800 python tools/mfu_sweep.py --attn 2>&1 | tee "tools/chip_logs/${ts}-attn-sweep.log"
+
+log mfu-sweep
+timeout 3600 python tools/mfu_sweep.py --quick 2>&1 | tee "tools/chip_logs/${ts}-mfu-sweep.log"
+
+log tpu-tests
+timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py -q \
+    2>&1 | tee "tools/chip_logs/${ts}-tpu-tests.log"
+
+echo "== chip session ${ts} complete; commit tools/chip_logs/ + BENCH_LASTGOOD.json"
