@@ -167,3 +167,33 @@ def test_sar_ndcg_benchmark():
     ndcg = RankingEvaluator(metric_name="ndcgAt", k=5).evaluate(
         model.transform(valid))
     assert_benchmark(benchmarks, "ndcg_at_5_sar", float(ndcg))
+
+
+def test_gbdt_training_throughput_regression():
+    """Training/inference THROUGHPUT regression for the GBDT engine — the
+    reference's headline perf claim is training speed (docs/lightgbm.md:
+    17-19, '10-30% faster'); accuracy CSVs alone can't catch a 10x
+    slowdown in the histogram/grower path.  Absolute numbers reflect this
+    1-core CI container; the wide precision bands absorb host noise while
+    still catching order-of-magnitude regressions."""
+    import time
+
+    rng = np.random.default_rng(0)
+    n, f, trees = 8000, 30, 25
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    y = (X @ w + 0.5 * rng.normal(size=n) > 0).astype(np.int32)
+    t = Table({"features": X, "label": y})
+    est = GBDTClassifier(num_iterations=trees, num_leaves=31)
+    t0 = time.perf_counter()
+    model = est.fit(t)
+    fit_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = model.transform(t)
+    pred_dt = time.perf_counter() - t0
+    acc = (np.asarray(out["prediction"]) == y).mean()
+    assert acc > 0.85  # the model must also be GOOD, not just fast
+
+    bench = load_benchmarks("benchmarks_gbdt_throughput.csv")
+    assert_benchmark(bench, "gbdt_train_row_trees_per_sec", n * trees / fit_dt)
+    assert_benchmark(bench, "gbdt_predict_rows_per_sec", n / pred_dt)
