@@ -104,6 +104,30 @@ def _best_of(scores, feature_mask):
     return idx, flat[idx]
 
 
+@jax.jit
+def _split_summary(hist, feature_mask, lambda_l1, lambda_l2,
+                   min_data_in_leaf, min_sum_hessian):
+    """One fused program per node: argmax split + its left/right stats as
+    a single [8] vector — the grower pulls 32 bytes per node instead of
+    the whole [F, B, 3] histogram plus separate scalar syncs (on a
+    remote/tunneled device, per-node round trips dominate the grow loop
+    otherwise)."""
+    scores = _split_scores(hist, lambda_l1, lambda_l2, min_data_in_leaf,
+                           min_sum_hessian)
+    idx, gain = _best_of(scores, feature_mask)
+    b = hist.shape[1]
+    feat = idx // b
+    thr = idx % b
+    # gather the winning feature FIRST, then scan one [B, 3] row — O(B),
+    # not a second full [F, B, 3] cumsum (F can be a 2^18 hash space)
+    cs = jnp.cumsum(hist[feat], axis=0)
+    left = cs[thr]
+    right = cs[b - 1] - left
+    # idx stays int32: float packing would corrupt splits once F*B > 2^24
+    return idx.astype(jnp.int32), jnp.concatenate(
+        [gain[None], left, right])
+
+
 def best_split(
     hist: jax.Array,
     lambda_l1: float,
@@ -115,28 +139,25 @@ def best_split(
 ) -> Optional[SplitInfo]:
     """Best (feature, bin) split of a node given its histogram, or None."""
     f, b, _ = hist.shape
-    scores = _split_scores(hist, lambda_l1, lambda_l2, min_data_in_leaf, min_sum_hessian)
     if feature_mask is None:
         feature_mask = np.ones(f, dtype=bool)
-    idx, gain = _best_of(scores, jnp.asarray(feature_mask))
-    gain = float(gain)
+    idx, out = jax.device_get(_split_summary(
+        hist, jnp.asarray(feature_mask), lambda_l1, lambda_l2,
+        min_data_in_leaf, min_sum_hessian))
+    gain = float(out[0])
     if not np.isfinite(gain) or gain <= min_gain:
         return None
-    idx = int(idx)
-    feat, thr = divmod(idx, b)
-    hist_np = np.asarray(hist)
-    left = hist_np[feat, : thr + 1].sum(axis=0)
-    right = hist_np[feat].sum(axis=0) - left
+    feat, thr = divmod(int(idx), b)
     return SplitInfo(
         feature=feat,
         bin_threshold=thr,
         gain=gain,
-        left_grad=float(left[0]),
-        left_hess=float(left[1]),
-        left_count=float(left[2]),
-        right_grad=float(right[0]),
-        right_hess=float(right[1]),
-        right_count=float(right[2]),
+        left_grad=float(out[1]),
+        left_hess=float(out[2]),
+        left_count=float(out[3]),
+        right_grad=float(out[4]),
+        right_hess=float(out[5]),
+        right_count=float(out[6]),
     )
 
 
