@@ -170,6 +170,10 @@ def _register_defaults():
     from .transformer import transformer_lm
 
     register_builder("transformer_lm", transformer_lm)
+    from . import vit as V
+
+    for name in ("vit_tiny", "vit_small", "vit_base"):
+        register_builder(name, getattr(V, name))
 
 
 _register_defaults()

@@ -40,10 +40,12 @@ def _decode_column(col: np.ndarray) -> List[Optional[np.ndarray]]:
 
 @register_stage
 class DeepVisionClassifier(Estimator):
-    """Fine-tune a ResNet on (image, label) rows, data-parallel on the mesh."""
+    """Fine-tune any registered vision backbone (ResNet/CNN zoo, ViT) on
+    (image, label) rows, data-parallel on the mesh."""
 
     backbone = Param("any registered vision builder (resnet18/34/50/101/152, "
-                     "alexnet, vgg11/16, convnet_cifar)", default="resnet18")
+                     "alexnet, vgg11/16, convnet_cifar, vit_tiny/small/base)",
+                     default="resnet18")
     input_col = Param("image column (image rows / encoded bytes / arrays)",
                       default="image")
     label_col = Param("label column", default="label")

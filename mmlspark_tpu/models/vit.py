@@ -1,0 +1,96 @@
+"""Vision Transformer backbones for the model zoo.
+
+Beyond-reference model family (the reference's CNTK zoo stops at CNNs —
+SURVEY §2.9.6, downloader/ModelDownloader.scala:26-263): ViT is the
+MXU-native image backbone.  ResNet-50 inference is bandwidth-bound on a
+v5e (whole-model MFU ceiling ~0.47, docs/performance.md); a ViT is almost
+entirely large dense matmuls — patch embedding is a single [P²C, E]
+matmul, and every block is LN + QKV/proj/MLP matmuls at S=196 — so its
+roofline sits where the chip's FLOPs are, not its HBM.
+
+TPU-first choices: NHWC uint8/f32 in, one conv-as-matmul patchify, bf16
+compute with f32 params (flax default), static [B, 196, E] shapes, GAP
+pooling by default (no CLS token: S stays 196 = 14², no ragged +1 that
+costs a padded attention lane).  Encoder blocks are the SAME `_Block` as
+TransformerLM (models/transformer.py) with non-causal attention — one
+validated block implementation serves both model families.
+
+Taps follow the zoo contract (ImageFeaturizer.scala:40-197 node
+addressing): ["logits", "pool", "encoded", "embed"], `taps[layer_names[1]]`
+is the penultimate feature vector.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.ring_attention import full_attention
+from .transformer import _Block
+
+__all__ = ["VisionTransformer", "vit_tiny", "vit_small", "vit_base"]
+
+
+class VisionTransformer(nn.Module):
+    """ViT over NHWC images; GAP pooling, pre-LN encoder blocks."""
+
+    patch_size: int = 16
+    embed_dim: int = 192
+    num_layers: int = 12
+    num_heads: int = 3
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    layer_names = ["logits", "pool", "encoded", "embed"]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        p = self.patch_size
+        if x.shape[1] % p or x.shape[2] % p:
+            raise ValueError(
+                f"ViT needs input H/W divisible by patch_size={p}; got "
+                f"{x.shape[1]}x{x.shape[2]} — resize (ImageFeaturizer does"
+                " this automatically from bundle.input_shape)")
+        taps: Dict[str, jnp.ndarray] = {}
+        x = x.astype(self.dtype)
+        # patchify as a conv: XLA lowers a stride-P PxP conv to one
+        # [B*S, P*P*C] @ [P*P*C, E] matmul — pure MXU work
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        b, gh, gw, e = x.shape
+        x = x.reshape(b, gh * gw, e)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, gh * gw, e), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        taps["embed"] = x
+        attn = lambda q, k, v: full_attention(q, k, v, causal=False)
+        for i in range(self.num_layers):
+            x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,
+                       name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        taps["encoded"] = x
+        pooled = jnp.mean(x, axis=1)
+        taps["pool"] = pooled.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(pooled).astype(jnp.float32)
+        taps["logits"] = logits
+        return logits, taps
+
+
+def vit_tiny(num_classes=1000, dtype=jnp.bfloat16, patch_size=16):
+    return VisionTransformer(patch_size=patch_size, embed_dim=192,
+                             num_layers=12, num_heads=3,
+                             num_classes=num_classes, dtype=dtype)
+
+
+def vit_small(num_classes=1000, dtype=jnp.bfloat16, patch_size=16):
+    return VisionTransformer(patch_size=patch_size, embed_dim=384,
+                             num_layers=12, num_heads=6,
+                             num_classes=num_classes, dtype=dtype)
+
+
+def vit_base(num_classes=1000, dtype=jnp.bfloat16, patch_size=16):
+    return VisionTransformer(patch_size=patch_size, embed_dim=768,
+                             num_layers=12, num_heads=12,
+                             num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll until the TPU backend answers, then run the full evidence sweep once
+# (tools/chip_session.sh).  The axon tunnel is transient: round 2 lost its
+# live capture to an outage, so the sweep must fire in whatever window
+# appears, unattended.
+cd "$(dirname "$0")/.."
+echo "[tunnel_watch] $(date -u +%H:%M:%SZ) watching"
+while true; do
+  if timeout 150 python -c \
+      "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" \
+      >/dev/null 2>&1; then
+    echo "[tunnel_watch] $(date -u +%H:%M:%SZ) tunnel up; running sweep"
+    bash tools/chip_session.sh
+    exit 0
+  fi
+  echo "[tunnel_watch] $(date -u +%H:%M:%SZ) probe failed; retry in 120s"
+  sleep 120
+done
