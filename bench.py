@@ -39,7 +39,6 @@ BATCH = 128
 # the e2e feed batches large: through a tunneled chip the fixed per-transfer
 # cost dominates, and on a real host bigger device_put chunks amortize too
 E2E_BATCH = 256
-WARMUP = 3
 ITERS = 10
 IMG = 224
 N_E2E = 512
@@ -71,6 +70,24 @@ def _probe_backend() -> bool:
         if attempt < PROBE_RETRIES - 1:
             time.sleep(30)
     return False
+
+
+def _best_of(run, iters: int, reps: int = 3) -> float:
+    """Best-of-`reps` wall seconds for `iters` dispatches of `run()` (which
+    must return a value to block on) — the one timing methodology every
+    measurement in this file and tools/mfu_sweep.py records with."""
+    import jax
+
+    jax.block_until_ready(run())  # warm
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = run()
+        jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
 
 
 def _chip_peak_flops() -> float:
@@ -154,7 +171,6 @@ def _measure_transformer(batch: int = 16, seq: int = 1024,
     forward's roofline caps near 0.47; see tools/roofline.py and
     docs/performance.md).  GPT-small-ish config, bf16, fwd+bwd+adam as
     ONE jitted step; FLOPs from XLA's own cost analysis."""
-    import time as _time
 
     import jax
     import jax.numpy as jnp
@@ -201,19 +217,43 @@ def _measure_transformer(batch: int = 16, seq: int = 1024,
     except Exception:  # noqa: BLE001
         flops_step = 0.0
     compiled = epoch.lower(params, opt_state, tokens).compile()
-    jax.block_until_ready(compiled(params, opt_state, tokens)[2])  # warm
-    best = None
-    for _ in range(3):
-        t0 = _time.perf_counter()
-        _p, _o, losses = compiled(params, opt_state, tokens)
-        jax.block_until_ready(losses)
-        dt = _time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
+    best = _best_of(lambda: compiled(params, opt_state, tokens)[2], iters=1)
     peak = _chip_peak_flops()
     return {
         "lm_tokens_per_sec": round(steps * batch * seq / best, 0),
         "lm_train_mfu": (round(steps * flops_step / best / peak, 4)
                          if peak and flops_step else None),
+    }
+
+
+def _measure_vit(batch: int = 128, iters: int = 10) -> dict:
+    """ViT-B/16 bf16 inference MFU — the matmul-dominated vision backbone.
+    ResNet-50's roofline caps near 0.47 MFU on a v5e (docs/performance.md);
+    ViT is where a vision workload actually reaches the >=0.5 MFU goal, so
+    the record carries both numbers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.models.bundle import FlaxBundle
+
+    bundle = FlaxBundle("vit_base", {"num_classes": 1000},
+                        input_shape=(IMG, IMG, 3))
+    dev_vars = jax.device_put(
+        jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16), bundle.variables))
+    jitted = jax.jit(lambda v, x: bundle.apply(v, x)["pool"])
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(batch, IMG, IMG, 3)), jnp.bfloat16)
+    compiled = jitted.lower(dev_vars, x).compile()
+    try:
+        flops = float(compiled.cost_analysis()["flops"])
+    except Exception:  # noqa: BLE001
+        flops = 35.1e9 * batch  # published ViT-B/16 fwd FLOPs
+    best = _best_of(lambda: compiled(dev_vars, x), iters)
+    peak = _chip_peak_flops()
+    return {
+        "vit_ips": round(iters * batch / best, 1),
+        "vit_mfu": round(iters * flops / best / peak, 4) if peak else None,
     }
 
 
@@ -245,15 +285,7 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
         flops_per_batch = float(compiled.cost_analysis()["flops"])
     except Exception:
         flops_per_batch = 8.2e9 * batch  # published ResNet-50 fwd FLOPs
-    compiled(dev_vars, x)[0].block_until_ready()
-    for _ in range(WARMUP):
-        compiled(dev_vars, x)
-    jax.block_until_ready(compiled(dev_vars, x))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = compiled(dev_vars, x)
-    out.block_until_ready()
-    fwd_dt = time.perf_counter() - t0
+    fwd_dt = _best_of(lambda: compiled(dev_vars, x), iters)
     forward_ips = iters * batch / fwd_dt
     peak = _chip_peak_flops()
     mfu = (iters * flops_per_batch / fwd_dt) / peak if peak else None
@@ -334,6 +366,10 @@ def _child_measure():
         train = {"train_samples_per_sec": None,
                  "train_error": str(e)[-200:]}
     try:
+        vit = _measure_vit()
+    except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
+        vit = {"vit_error": str(e)[-200:]}
+    try:
         lm = _measure_transformer()
     except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
         if _is_infra_error(e):
@@ -348,7 +384,7 @@ def _child_measure():
                 lm["lm_attn_fallback"] = True
             except Exception as e2:  # noqa: BLE001
                 lm = {"lm_error": f"{str(e)[-120:]} | retry: {str(e2)[-120:]}"}
-    print(json.dumps({"res": res, "train": train, "lm": lm}))
+    print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm}))
 
 
 def main():
@@ -403,7 +439,7 @@ def main():
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child-measure"],
-            capture_output=True, text=True, timeout=2100)
+            capture_output=True, text=True, timeout=2400)
     except subprocess.TimeoutExpired:
         _report_stale("measurement timed out (tunnel hang); last good")
         return
@@ -444,6 +480,7 @@ def main():
         **({"train_error": train["train_error"]}
            if train.get("train_samples_per_sec") is None
            and "train_error" in train else {}),
+        **{k: v for k, v in child.get("vit", {}).items() if v is not None},
         **{k: v for k, v in child.get("lm", {}).items() if v is not None},
         "device_kind": res["device_kind"],
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

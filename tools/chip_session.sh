@@ -6,7 +6,7 @@
 #                           scanned CIFAR train / scanned LM train)
 #   2. mfu_sweep --attn   — Mosaic-validate the fused attention kernel
 #                           (parity enforced; JSON is validation evidence)
-#   3. mfu_sweep --quick  — ResNet-50 batch sweep vs the roofline ceiling
+#   3. mfu_sweep --quick  — ResNet-50 + ViT-B batch sweep vs the roofline
 #   4. on-TPU pytest      — clears the two real-hardware skips (fused
 #                           affine/gray Mosaic compile + attention kernel)
 # Each stage logs to tools/chip_logs/ with a timestamp; stages run even if
@@ -19,13 +19,16 @@ ts=$(date -u +%Y%m%dT%H%M%SZ)
 log() { echo "== $1 -> tools/chip_logs/${ts}-$1.log"; }
 
 log bench
-timeout 2400 python bench.py 2>&1 | tee "tools/chip_logs/${ts}-bench.log"
+# margin: up to 720s of backend probes + the 2400s child watchdog must both
+# fit, or the stale-fallback JSON the watchdog exists to print is lost
+timeout 3300 python bench.py 2>&1 | tee "tools/chip_logs/${ts}-bench.log"
 
 log attn-sweep
 timeout 1800 python tools/mfu_sweep.py --attn 2>&1 | tee "tools/chip_logs/${ts}-attn-sweep.log"
 
 log mfu-sweep
-timeout 3600 python tools/mfu_sweep.py --quick 2>&1 | tee "tools/chip_logs/${ts}-mfu-sweep.log"
+# 5 quick configs (resnet50 b128/256/512 + vit_base b128/256) x 900s child cap
+timeout 5400 python tools/mfu_sweep.py --quick 2>&1 | tee "tools/chip_logs/${ts}-mfu-sweep.log"
 
 log tpu-tests
 timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py -q \

@@ -5,7 +5,7 @@ XLA flags only apply at backend init, so every config runs in a fresh
 subprocess.  Usage (tunnel must be up):
 
     python tools/mfu_sweep.py              # the standard sweep
-    python tools/mfu_sweep.py --quick      # batch sweep only
+    python tools/mfu_sweep.py --quick      # batch sweeps only (resnet50 + vit)
 
 Results feed docs/performance.md's roofline section; tools/roofline.py
 computes the analytic ceiling these numbers are judged against.
@@ -49,20 +49,24 @@ def _pin_platform():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 CONFIGS = [
-    # (tag, batch, extra XLA flags)
-    ("b128", 128, ""),
-    ("b256", 256, ""),
-    ("b512", 512, ""),
+    # (tag, batch, extra XLA flags, builder)
+    ("b128", 128, "", "resnet50"),
+    ("b256", 256, "", "resnet50"),
+    ("b512", 512, "", "resnet50"),
     ("b256-latency-hiding", 256,
-     "--xla_tpu_enable_latency_hiding_scheduler=true"),
+     "--xla_tpu_enable_latency_hiding_scheduler=true", "resnet50"),
     ("b256-async-all", 256,
-     "--xla_enable_async_all_gather=true"),
+     "--xla_enable_async_all_gather=true", "resnet50"),
+    # ViT-B is the matmul-dominated vision backbone: this is where the
+    # >=0.5 MFU the CNN roofline forbids is actually available
+    ("vit-b128", 128, "", "vit_base"),
+    ("vit-b256", 256, "", "vit_base"),
 ]
-QUICK = {"b128", "b256", "b512"}
+QUICK = {"b128", "b256", "b512", "vit-b128", "vit-b256"}
 
 
-def child(batch: int) -> int:
-    """Runs in the measurement subprocess: jitted ResNet-50 bf16 forward."""
+def child(batch: int, builder: str = "resnet50") -> int:
+    """Runs in the measurement subprocess: jitted bf16 backbone forward."""
     _pin_platform()
     import jax
     import jax.numpy as jnp
@@ -72,7 +76,7 @@ def child(batch: int) -> int:
     from bench import _chip_peak_flops
     from mmlspark_tpu.models.bundle import FlaxBundle
 
-    bundle = FlaxBundle("resnet50", {"num_classes": 1000},
+    bundle = FlaxBundle(builder, {"num_classes": 1000},
                         input_shape=(224, 224, 3))
     dev_vars = jax.device_put(
         jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16), bundle.variables))
@@ -90,6 +94,7 @@ def child(batch: int) -> int:
     kind = jax.devices()[0].device_kind
     peak = _chip_peak_flops()
     print(json.dumps({
+        "builder": builder,
         "batch": batch,
         "ips": round(1000.0 * batch / ms, 1),
         "ms_per_batch": round(ms, 2),
@@ -167,12 +172,13 @@ def main():
     ap.add_argument("--attn", action="store_true",
                     help="fused_attention vs XLA dense on the chip")
     ap.add_argument("--child", type=int, default=None)
+    ap.add_argument("--builder", default="resnet50")
     args = ap.parse_args()
     if args.child is not None:
-        return child(args.child)
+        return child(args.child, args.builder)
     if args.attn:
         return attn_child()
-    for tag, batch, flags in CONFIGS:
+    for tag, batch, flags, builder in CONFIGS:
         if args.quick and tag not in QUICK:
             continue
         env = dict(os.environ)
@@ -181,7 +187,7 @@ def main():
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--child", str(batch)],
+                 "--child", str(batch), "--builder", builder],
                 env=env, capture_output=True, text=True, timeout=900)
         except subprocess.TimeoutExpired:
             print(json.dumps({"tag": tag, "error": "timeout"}))
