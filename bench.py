@@ -74,8 +74,8 @@ def _probe_backend() -> bool:
 
 def _best_of(run, iters: int, reps: int = 3) -> float:
     """Best-of-`reps` wall seconds for `iters` dispatches of `run()` (which
-    must return a value to block on) — the one timing methodology every
-    measurement in this file and tools/mfu_sweep.py records with."""
+    must return a value to block on).  tools/mfu_sweep.py's `_bench_ms`
+    delegates here, so every recorded number shares this methodology."""
     import jax
 
     jax.block_until_ready(run())  # warm

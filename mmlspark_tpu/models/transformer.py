@@ -37,6 +37,9 @@ class _Block(nn.Module):
     mlp_ratio: int
     dtype: Any
     attn_fn: Callable
+    # injection point for quantized inference (ops/quant.QuantDense): same
+    # param pytree as nn.Dense, so trained weights serve either class
+    dense_cls: Any = nn.Dense
 
     @nn.compact
     def __call__(self, x, cache=None, pos=None):
@@ -51,8 +54,8 @@ class _Block(nn.Module):
         h = self.num_heads
         d = e // h
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        qkv = nn.Dense(3 * e, use_bias=False, dtype=self.dtype,
-                       name="qkv")(y)
+        qkv = self.dense_cls(3 * e, use_bias=False, dtype=self.dtype,
+                             name="qkv")(y)
         q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
         if cache is None:
             # expose this layer's K/V to generation prefill (a no-op
@@ -82,12 +85,13 @@ class _Block(nn.Module):
             a = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype),
                            v_cache, preferred_element_type=jnp.float32)
         a = a.astype(self.dtype).reshape(b, s, e)
-        x = x + nn.Dense(e, use_bias=False, dtype=self.dtype,
-                         name="proj")(a)
+        x = x + self.dense_cls(e, use_bias=False, dtype=self.dtype,
+                               name="proj")(a)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="mlp_in")(y)
+        y = self.dense_cls(self.mlp_ratio * e, dtype=self.dtype,
+                           name="mlp_in")(y)
         y = nn.gelu(y)
-        out = x + nn.Dense(e, dtype=self.dtype, name="mlp_out")(y)
+        out = x + self.dense_cls(e, dtype=self.dtype, name="mlp_out")(y)
         return out if cache is None else (out, cache)
 
 

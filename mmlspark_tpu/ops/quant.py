@@ -1,0 +1,85 @@
+"""Int8 post-training-quantized inference ops.
+
+Beyond-reference, TPU-first: a v5e's MXU runs int8 matmuls at ~2x its bf16
+FLOP rate (394 TOPS vs 197 TFLOP/s), so inference-heavy paths (the
+reference's CNTKModel scoring role, CNTKModel.scala:88-140) can trade a
+little precision for double math throughput with NO retraining and NO
+separate checkpoint format:
+
+- `QuantDense` keeps the exact param pytree of `nn.Dense` (f32 kernel/bias)
+  — any trained checkpoint loads unchanged; quantization happens inside the
+  forward, on device.
+- Weights: symmetric per-output-channel int8 (max|w|/127 scales).
+- Activations: dynamic symmetric per-tensor int8, computed per call.
+- The matmul itself runs int8 x int8 -> int32 on the MXU
+  (`preferred_element_type=int32`), then dequantizes with one fused
+  elementwise scale.
+
+Numerics: symmetric scaling bounds |q| <= 127 by construction, so the int8
+casts cannot overflow; int32 accumulation is exact for any k <= ~2^16
+(127*127*k < 2^31), far past any layer width here.
+
+Weights re-quantize inside each forward (they are jit arguments, so XLA
+cannot fold them): the extra cost is one f32 kernel read + elementwise
+round/cast per call — for ViT-B at batch 128 that is ~344MB against a
+~23ms step, ~2% overhead, which keeping the checkpoint format unchanged
+buys.  Small-batch serving loops that want it back should add a
+load-time prequant pass (int8 kernels + scale arrays as the variables)
+— the planned follow-up, not done here.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["int8_dense", "QuantDense"]
+
+_EPS = 1e-8
+
+
+def int8_dense(x: jnp.ndarray, kernel: jnp.ndarray,
+               bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """f32/bf16 `x [..., K] @ kernel [K, N]` executed as int8 on the MXU.
+
+    Returns f32.  Weight scales are per-output-channel, activation scale is
+    per-tensor dynamic (one max-reduce — cheap next to the matmul)."""
+    x = x.astype(jnp.float32)
+    kernel = kernel.astype(jnp.float32)
+    ws = jnp.maximum(jnp.max(jnp.abs(kernel), axis=0), _EPS) / 127.0  # [N]
+    wq = jnp.round(kernel / ws).astype(jnp.int8)
+    xs = jnp.maximum(jnp.max(jnp.abs(x)), _EPS) / 127.0  # scalar, dynamic
+    xq = jnp.round(x / xs).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (xs * ws)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y
+
+
+class QuantDense(nn.Module):
+    """Drop-in for `nn.Dense` with int8 compute.
+
+    Same constructor surface and the same parameter names/shapes/dtypes
+    (f32 'kernel' [K, N], optional 'bias' [N]) — swapping module classes
+    re-uses trained weights as-is.  `dtype` is the OUTPUT dtype (matching
+    nn.Dense's compute-dtype contract closely enough for the pre-LN
+    transformer blocks here, whose next op casts anyway)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.float32)
+                if self.use_bias else None)
+        return int8_dense(x, kernel, bias).astype(self.dtype)

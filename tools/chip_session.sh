@@ -27,8 +27,8 @@ log attn-sweep
 timeout 1800 python tools/mfu_sweep.py --attn 2>&1 | tee "tools/chip_logs/${ts}-attn-sweep.log"
 
 log mfu-sweep
-# 5 quick configs (resnet50 b128/256/512 + vit_base b128/256) x 900s child cap
-timeout 5400 python tools/mfu_sweep.py --quick 2>&1 | tee "tools/chip_logs/${ts}-mfu-sweep.log"
+# 6 quick configs (resnet50 b128/256/512 + vit b128/256 + vit-int8) x 900s cap
+timeout 6300 python tools/mfu_sweep.py --quick 2>&1 | tee "tools/chip_logs/${ts}-mfu-sweep.log"
 
 log tpu-tests
 timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py -q \

@@ -22,21 +22,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _bench_ms(fn, *args, iters: int = 5, reps: int = 3) -> float:
-    """Best-of-`reps` wall time of `iters` dispatches, ms per call."""
-    import time
+    """Best-of-`reps` wall time of `iters` dispatches, ms per call —
+    bench.py's `_best_of` (the single timing methodology), in ms units."""
+    sys.path.insert(0, ROOT)
+    from bench import _best_of
 
-    import jax
-
-    jax.block_until_ready(fn(*args))  # warm/compile
-    best = None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            y = fn(*args)
-        jax.block_until_ready(y)
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    return 1000.0 * best / iters
+    return 1000.0 * _best_of(lambda: fn(*args), iters, reps) / iters
 
 
 def _pin_platform():
@@ -61,8 +52,11 @@ CONFIGS = [
     # >=0.5 MFU the CNN roofline forbids is actually available
     ("vit-b128", 128, "", "vit_base"),
     ("vit-b256", 256, "", "vit_base"),
+    # int8 PTQ encoder matmuls (ops/quant.py): ips is the headline here;
+    # "mfu" stays normalized to the bf16 peak, so >1.0 is possible
+    ("vit-b128-int8", 128, "", "vit_base_int8"),
 ]
-QUICK = {"b128", "b256", "b512", "vit-b128", "vit-b256"}
+QUICK = {"b128", "b256", "b512", "vit-b128", "vit-b256", "vit-b128-int8"}
 
 
 def child(batch: int, builder: str = "resnet50") -> int:
@@ -76,10 +70,20 @@ def child(batch: int, builder: str = "resnet50") -> int:
     from bench import _chip_peak_flops
     from mmlspark_tpu.models.bundle import FlaxBundle
 
-    bundle = FlaxBundle(builder, {"num_classes": 1000},
-                        input_shape=(224, 224, 3))
-    dev_vars = jax.device_put(
-        jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16), bundle.variables))
+    kwargs = {"num_classes": 1000}
+    base = builder
+    if builder.endswith("_int8"):
+        base = builder[: -len("_int8")]
+        kwargs["quant"] = True
+    bundle = FlaxBundle(base, kwargs, input_shape=(224, 224, 3))
+    if kwargs.get("quant"):
+        # the int8 path's deployment contract is the UNCHANGED f32 pytree
+        # (ops/quant.py) — casting to bf16 here would halve weight reads
+        # and change numerics vs what quant=True actually ships
+        dev_vars = jax.device_put(bundle.variables)
+    else:
+        dev_vars = jax.device_put(jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.bfloat16), bundle.variables))
 
     def forward(v, x):
         return bundle.apply(v, x)["pool"]
