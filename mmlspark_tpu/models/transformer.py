@@ -113,8 +113,19 @@ class TransformerLM(nn.Module):
     # stats, as full_attention/ring_attention/ulysses_attention all do)
     # and should return f32.
     attn_fn: Optional[Callable] = None
+    # int8 inference (ops/quant.py): block + head matmuls run as int8 on
+    # the MXU.  Inference-only (round() kills gradients); pairs with
+    # prequantize() for weight-bandwidth-bound batch-1 decode, where int8
+    # weight reads are the whole game.
+    quant: bool = False
     layer_names = ["logits", "pool", "hidden", "embed"]
     input_dtype = jnp.int32  # token ids (FlaxBundle auto-init dummy dtype)
+
+    @property
+    def _dense_cls(self):
+        from ..ops.quant import dense_cls
+
+        return dense_cls(self.quant)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
@@ -143,12 +154,13 @@ class TransformerLM(nn.Module):
         taps["embed"] = x
         for i in range(self.num_layers):
             x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,
-                       name=f"block{i}")(x)
+                       dense_cls=self._dense_cls, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         taps["hidden"] = x
         taps["pool"] = jnp.mean(x, axis=1).astype(jnp.float32)
-        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
-                          name="head")(x).astype(jnp.float32)
+        logits = self._dense_cls(self.vocab_size, use_bias=False,
+                                 dtype=self.dtype,
+                                 name="head")(x).astype(jnp.float32)
         taps["logits"] = logits
         return logits, taps
 
@@ -167,20 +179,23 @@ class TransformerLM(nn.Module):
         new_cache = []
         for i in range(self.num_layers):
             x, layer_cache = _Block(
-                self.num_heads, self.mlp_ratio, self.dtype,
-                attn_fn=None, name=f"block{i}")(x, cache=cache[i], pos=pos)
+                self.num_heads, self.mlp_ratio, self.dtype, attn_fn=None,
+                dense_cls=self._dense_cls,
+                name=f"block{i}")(x, cache=cache[i], pos=pos)
             new_cache.append(layer_cache)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
-                          name="head")(x).astype(jnp.float32)
+        logits = self._dense_cls(self.vocab_size, use_bias=False,
+                                 dtype=self.dtype,
+                                 name="head")(x).astype(jnp.float32)
         return logits, tuple(new_cache)
 
 
 def transformer_lm(vocab_size=1024, embed_dim=128, num_layers=2, num_heads=4,
                    max_len=2048, dtype=jnp.bfloat16, attn_fn=None,
-                   num_classes=None):
+                   quant=False, num_classes=None):
     """Builder (zoo registry).  `num_classes` is accepted and ignored so the
     generic builder call sites (get_builder(name)(num_classes=...)) work."""
     return TransformerLM(vocab_size=vocab_size, embed_dim=embed_dim,
                          num_layers=num_layers, num_heads=num_heads,
-                         max_len=max_len, dtype=dtype, attn_fn=attn_fn)
+                         max_len=max_len, dtype=dtype, attn_fn=attn_fn,
+                         quant=quant)

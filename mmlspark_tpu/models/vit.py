@@ -69,14 +69,10 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(self.dtype)
         taps["embed"] = x
         attn = lambda q, k, v: full_attention(q, k, v, causal=False)
-        if self.quant:
-            from ..ops.quant import QuantDense
-            dense_cls = QuantDense
-        else:
-            dense_cls = nn.Dense
+        from ..ops.quant import dense_cls
         for i in range(self.num_layers):
             x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,
-                       dense_cls=dense_cls, name=f"block{i}")(x)
+                       dense_cls=dense_cls(self.quant), name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         taps["encoded"] = x
         pooled = jnp.mean(x, axis=1)

@@ -73,3 +73,74 @@ def test_quant_bundle_via_featurizer(rng):
         Table({"image": rows}))
     assert out["features"].shape == (3, 192)
     assert np.all(np.isfinite(out["features"]))
+
+
+def test_prequantize_matches_on_the_fly(rng):
+    from mmlspark_tpu.models.vit import vit_tiny
+    from mmlspark_tpu.ops.quant import prequantize
+
+    model = vit_tiny(num_classes=4, dtype=jnp.float32, quant=True)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+    assert "quant" not in variables  # init must NOT bake a quant snapshot
+    on_the_fly, _ = model.apply(variables, x)
+    qvars = prequantize(model, variables, x)
+    wq = qvars["quant"]["block0"]["qkv"]["wq"]
+    assert wq.dtype == jnp.int8
+    pre, _ = model.apply(qvars, x)
+    # prequant stores exactly what the on-the-fly path computes
+    np.testing.assert_array_equal(np.asarray(on_the_fly), np.asarray(pre))
+
+
+def test_quant_lm_generates_with_prequantized_weights(rng):
+    from mmlspark_tpu.models.generation import generate
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.ops.quant import prequantize
+
+    model = transformer_lm(vocab_size=64, embed_dim=32, num_layers=2,
+                           num_heads=2, max_len=64, dtype=jnp.float32,
+                           quant=True)
+    prompt = jnp.asarray(rng.integers(0, 64, size=(2, 5)), jnp.int32)
+    variables = {c: v for c, v in model.init(
+        {"params": jax.random.PRNGKey(0)}, prompt).items() if c != "kvcache"}
+    qvars = prequantize(model, variables, prompt)
+    out = generate(model, qvars, prompt, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 64))
+    # decode must actually read the prequantized weights: corrupting the
+    # int8 copy (params untouched) must change the generation
+    import copy
+    bad = copy.deepcopy(jax.device_get(qvars))
+    bad["quant"]["block0"]["qkv"]["wq"] = -np.asarray(
+        bad["quant"]["block0"]["qkv"]["wq"])
+    out_bad = generate(model, bad, prompt, max_new_tokens=6)
+    assert not np.array_equal(np.asarray(out), np.asarray(out_bad))
+
+
+def test_prequantize_refreshes_after_param_update(rng):
+    from mmlspark_tpu.models.vit import vit_tiny
+    from mmlspark_tpu.ops.quant import prequantize
+
+    model = vit_tiny(num_classes=3, dtype=jnp.float32, quant=True)
+    x = jnp.ones((1, 32, 32, 3), jnp.float32)
+    v = model.init({"params": jax.random.PRNGKey(0)}, x)
+    q1 = prequantize(model, v, x)
+    q1["params"] = jax.tree.map(lambda a: a * 3.0, q1["params"])
+    # re-prequantizing an already-quantized dict must recompute, not
+    # re-emit the stale int8 copy
+    q2 = prequantize(model, q1, x)
+    assert not np.allclose(np.asarray(q1["quant"]["block0"]["qkv"]["ws"]),
+                           np.asarray(q2["quant"]["block0"]["qkv"]["ws"]))
+
+
+def test_prequantize_without_quant_layers_is_descriptive():
+    import pytest
+
+    from mmlspark_tpu.models.vit import vit_tiny
+    from mmlspark_tpu.ops.quant import prequantize
+
+    model = vit_tiny(num_classes=3, dtype=jnp.float32, quant=False)
+    x = jnp.ones((1, 32, 32, 3), jnp.float32)
+    v = model.init({"params": jax.random.PRNGKey(0)}, x)
+    with pytest.raises(ValueError, match="no QuantDense"):
+        prequantize(model, v, x)

@@ -19,12 +19,12 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 
 
 def _bench_ms(fn, *args, iters: int = 5, reps: int = 3) -> float:
     """Best-of-`reps` wall time of `iters` dispatches, ms per call —
     bench.py's `_best_of` (the single timing methodology), in ms units."""
-    sys.path.insert(0, ROOT)
     from bench import _best_of
 
     return 1000.0 * _best_of(lambda: fn(*args), iters, reps) / iters
@@ -66,7 +66,6 @@ def child(batch: int, builder: str = "resnet50") -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    sys.path.insert(0, ROOT)
     from bench import _chip_peak_flops
     from mmlspark_tpu.models.bundle import FlaxBundle
 
@@ -121,7 +120,6 @@ def attn_child() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    sys.path.insert(0, ROOT)
     from mmlspark_tpu.ops import attention_kernels as ak
     from mmlspark_tpu.ops.attention_kernels import fused_attention
     from mmlspark_tpu.parallel.ring_attention import full_attention
