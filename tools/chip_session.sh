@@ -30,6 +30,9 @@ log mfu-sweep
 # 6 quick configs (resnet50 b128/256/512 + vit b128/256 + vit-int8) x 900s cap
 timeout 6300 python tools/mfu_sweep.py --quick 2>&1 | tee "tools/chip_logs/${ts}-mfu-sweep.log"
 
+log decode-sweep
+timeout 1800 python tools/mfu_sweep.py --decode 2>&1 | tee "tools/chip_logs/${ts}-decode-sweep.log"
+
 log tpu-tests
 timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py -q \
     2>&1 | tee "tools/chip_logs/${ts}-tpu-tests.log"

@@ -168,11 +168,52 @@ def attn_child() -> int:
     return 1 if failures else 0
 
 
+def decode_child() -> int:
+    """Batch-1 KV-cached decode tokens/sec: f32 weights vs prequantized
+    int8 (ops/quant.prequantize).  Decode is weight-bandwidth-bound, so
+    the int8/f32 ratio measures realized HBM savings (~4x bytes)."""
+    _pin_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.models.generation import generate
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.ops.quant import prequantize
+
+    cfg = dict(vocab_size=8192, embed_dim=768, num_layers=12, num_heads=12,
+               max_len=512)
+    if os.environ.get("DECODE_SWEEP_SMALL"):  # CPU smoke override
+        cfg = dict(vocab_size=256, embed_dim=64, num_layers=2, num_heads=2,
+                   max_len=64)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg["vocab_size"], size=(1, 16)), jnp.int32)
+    new_tokens = cfg["max_len"] - 32
+    results = {}
+    for tag, quant in (("f32", False), ("int8", True)):
+        model = transformer_lm(dtype=jnp.float32, quant=quant, **cfg)
+        variables = {c: v for c, v in jax.jit(
+            lambda r, t: model.init(r, t))(
+                jax.random.PRNGKey(0), prompt).items() if c != "kvcache"}
+        if quant:
+            variables = prequantize(model, variables, prompt)
+        run = jax.jit(lambda v, p: generate(model, v, p, new_tokens))
+        ms = _bench_ms(run, variables, prompt, iters=1)
+        results[f"decode_tok_per_sec_{tag}"] = round(1000.0 * new_tokens / ms, 1)
+    results["int8_speedup"] = round(
+        results["decode_tok_per_sec_int8"] / results["decode_tok_per_sec_f32"], 2)
+    results["device"] = jax.devices()[0].device_kind
+    print(json.dumps(results))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--attn", action="store_true",
                     help="fused_attention vs XLA dense on the chip")
+    ap.add_argument("--decode", action="store_true",
+                    help="batch-1 decode tokens/sec, f32 vs prequant int8")
     ap.add_argument("--child", type=int, default=None)
     ap.add_argument("--builder", default="resnet50")
     args = ap.parse_args()
@@ -180,6 +221,8 @@ def main():
         return child(args.child, args.builder)
     if args.attn:
         return attn_child()
+    if args.decode:
+        return decode_child()
     for tag, batch, flags, builder in CONFIGS:
         if args.quick and tag not in QUICK:
             continue
