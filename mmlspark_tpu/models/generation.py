@@ -27,15 +27,36 @@ from .transformer import TransformerLM
 __all__ = ["generate"]
 
 
+def _filter_logits(lg: jnp.ndarray, top_k: Optional[int],
+                   top_p: Optional[float]) -> jnp.ndarray:
+    """Mask logits outside the top-k set and/or the top-p nucleus to -inf.
+    Static shapes throughout (sort + threshold, no gather-by-count)."""
+    if top_k is not None and top_k < lg.shape[-1]:
+        kth = jnp.sort(lg, axis=-1)[..., -top_k][..., None]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    if top_p is not None and top_p < 1.0:
+        srt = jnp.sort(lg, axis=-1)[..., ::-1]                # descending
+        cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
+        # smallest set with cumulative prob >= top_p: a token stays if the
+        # mass BEFORE it (exclusive) is still < top_p
+        keep = (cum - jax.nn.softmax(srt, axis=-1)) < top_p
+        cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)[..., None]
+        lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+    return lg
+
+
 def generate(model: TransformerLM, variables, prompt: jnp.ndarray,
              max_new_tokens: int, temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
-             eos_id: Optional[int] = None) -> jnp.ndarray:
+             eos_id: Optional[int] = None,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> jnp.ndarray:
     """prompt [B, S_p] int32 -> [B, S_p + max_new_tokens] int32.
 
     temperature == 0 is greedy argmax; > 0 samples categorically with
-    `rng` (required then).  With `eos_id`, rows that emit it keep
-    emitting it and their logits stop mattering (static shapes: the
+    `rng` (required then), optionally restricted to the `top_k` highest
+    logits and/or the `top_p` nucleus.  With `eos_id`, rows that emit it
+    keep emitting it and their logits stop mattering (static shapes: the
     scan always runs max_new_tokens steps).
     """
     b, s_p = prompt.shape
@@ -69,6 +90,7 @@ def generate(model: TransformerLM, variables, prompt: jnp.ndarray,
     def sample(lg, key):
         if temperature == 0.0:
             return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        lg = _filter_logits(lg, top_k, top_p)
         return jax.random.categorical(key, lg / temperature).astype(jnp.int32)
 
     # ---- decode: one scan over the new tokens ---------------------------

@@ -82,3 +82,36 @@ def test_overflow_rejected(model_and_vars):
     model, variables = model_and_vars
     with pytest.raises(ValueError, match="max_len"):
         generate(model, variables, jnp.zeros((1, 30), jnp.int32), 10)
+
+
+def test_filter_logits_top_k_and_top_p():
+    from mmlspark_tpu.models.generation import _filter_logits
+
+    lg = jnp.asarray([[4.0, 3.0, 2.0, 1.0, 0.0]])
+    k2 = np.asarray(_filter_logits(lg, 2, None))
+    assert np.isfinite(k2[0, :2]).all() and np.isneginf(k2[0, 2:]).all()
+    # nucleus: softmax([4,3,2,1,0]) ~ [.64,.24,.09,.03,.01]; p=.7 keeps 2
+    p7 = np.asarray(_filter_logits(lg, None, 0.7))
+    assert np.isfinite(p7[0, :2]).all() and np.isneginf(p7[0, 2:]).all()
+    # p=1 and k=vocab are no-ops
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(lg, 5, 1.0)), np.asarray(lg))
+    # top-p always keeps at least the argmax even for tiny p
+    p0 = np.asarray(_filter_logits(lg, None, 1e-9))
+    assert np.isfinite(p0[0, 0]) and np.isneginf(p0[0, 1:]).all()
+
+
+def test_generate_top_k_sampling_stays_in_top_set(model_and_vars):
+    model, variables = model_and_vars
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    # top_k=1 sampling at any temperature IS greedy: the only candidate
+    # left is the argmax — a sharp behavioral check of the filter
+    greedy = generate(model, variables, prompt, max_new_tokens=5)
+    sampled = generate(model, variables, prompt, max_new_tokens=5,
+                       temperature=1.5, rng=jax.random.PRNGKey(7), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+    # and nucleus p->0 degenerates to greedy the same way
+    nucleus = generate(model, variables, prompt, max_new_tokens=5,
+                       temperature=2.0, rng=jax.random.PRNGKey(3),
+                       top_p=1e-9)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
