@@ -116,6 +116,16 @@ class QuantDense(nn.Module):
         return y.astype(self.dtype)
 
 
+def quantize_kv_row(x: jnp.ndarray):
+    """[..., H, D] K/V rows -> (int8 rows, f32 per-row-per-head scales
+    [..., H]).  Symmetric per-(position, head) scaling: each attention
+    row dequantizes exactly like int8_matmul's weights do."""
+    s = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1),
+                    _EPS) / 127.0
+    q = jnp.round(x.astype(jnp.float32) / s[..., None]).astype(jnp.int8)
+    return q, s
+
+
 def dense_cls(quant: bool):
     """The one quant -> dense-class selection both model families use."""
     return QuantDense if quant else nn.Dense

@@ -190,14 +190,16 @@ def decode_child() -> int:
         0, cfg["vocab_size"], size=(1, 16)), jnp.int32)
     new_tokens = cfg["max_len"] - 32
     results = {}
-    for tag, quant in (("f32", False), ("int8", True)):
+    for tag, quant, kv in (("f32", False, None), ("int8", True, None),
+                           ("int8_kv8", True, "int8")):
         model = transformer_lm(dtype=jnp.float32, quant=quant, **cfg)
         variables = {c: v for c, v in jax.jit(
             lambda r, t: model.init(r, t))(
                 jax.random.PRNGKey(0), prompt).items() if c != "kvcache"}
         if quant:
             variables = prequantize(model, variables, prompt)
-        run = jax.jit(lambda v, p: generate(model, v, p, new_tokens))
+        run = jax.jit(lambda v, p, _m=model, _kv=kv: generate(
+            _m, v, p, new_tokens, kv_cache_dtype=_kv))
         ms = _bench_ms(run, variables, prompt, iters=1)
         results[f"decode_tok_per_sec_{tag}"] = round(1000.0 * new_tokens / ms, 1)
     results["int8_speedup"] = round(
