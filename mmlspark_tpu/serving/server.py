@@ -31,7 +31,7 @@ from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from .journal import EpochJournal
 
 __all__ = ["CachedRequest", "WorkerServer", "ServingServer", "ServiceInfo",
-           "parse_request", "make_reply"]
+           "StreamWriter", "parse_request", "make_reply"]
 
 
 @dataclass
@@ -58,6 +58,13 @@ class CachedRequest:
     done: threading.Event = field(default_factory=threading.Event)
     response: Optional[HTTPResponseData] = None
     attempts: int = 0
+    # streaming reply (stream_to): chunk queue drained by the handler
+    # thread; None sentinel closes the stream.  handler_gone flips when
+    # the handler thread exits (disconnect, timeout, drain done) so the
+    # producer stops writing into a queue nobody reads.
+    stream: Optional["Queue[Optional[bytes]]"] = None
+    stream_headers: Optional[Dict[str, str]] = None
+    handler_gone: threading.Event = field(default_factory=threading.Event)
 
 
 class WorkerServer:
@@ -130,10 +137,19 @@ class WorkerServer:
                 with outer._routing_lock:
                     outer.routing[req.id] = req
                 outer.queue.put(req)
-                if not req.done.wait(outer.handler_timeout):
-                    outer._finish(req.id)
-                    self.send_error(504, "model timed out")
-                    return
+                try:
+                    if not req.done.wait(outer.handler_timeout):
+                        outer._finish(req.id)
+                        self.send_error(504, "model timed out")
+                        return
+                    if req.stream is not None:
+                        self._drain_stream(req)
+                        return
+                finally:
+                    # all exits (reply sent, 504, disconnect) tell the
+                    # producer this exchange is over — StreamWriter.write
+                    # raises instead of filling a queue nobody drains
+                    req.handler_gone.set()
                 resp = req.response or HTTPResponseData(500, "no response")
                 body = resp.entity or b""
                 self.send_response(resp.status_code)
@@ -142,6 +158,39 @@ class WorkerServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _drain_stream(self, req: CachedRequest):
+                """Chunked streaming reply (stream_to): each queued buffer
+                flushes to the socket as its own chunk, so the client sees
+                tokens as they are produced; the 0-length terminator keeps
+                the connection reusable."""
+                self.send_response(200)
+                for k, v in (req.stream_headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        try:
+                            chunk = req.stream.get(
+                                timeout=outer.handler_timeout)
+                        except Empty:
+                            # producer stalled without close(): abandon,
+                            # and drop the connection so the unterminated
+                            # chunked body can't poison keep-alive
+                            self.close_connection = True
+                            return
+                        if chunk is None:
+                            break
+                        if chunk:
+                            self.wfile.write(
+                                f"{len(chunk):X}\r\n".encode() + chunk
+                                + b"\r\n")
+                            self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:  # client went away mid-stream
+                    self.close_connection = True
 
             def log_message(self, *a):  # quiet
                 pass
@@ -237,6 +286,23 @@ class WorkerServer:
         req.attempts += 1
         self.queue.put(req)
 
+    def stream_to(self, request_id: str,
+                  headers: Optional[Dict[str, str]] = None) -> "StreamWriter":
+        """Open a chunked streaming reply over the held exchange — the
+        token-by-token serving shape for generation (beyond-reference: the
+        reference's replyTo is single-shot, HTTPSinkV2.scala:535-553).
+        Returns a writer: `.write(bytes)` flushes one chunk to the client
+        immediately, `.close()` ends the stream (and journals the reply).
+        At-most-once: a crash mid-stream is the client's to retry."""
+        with self._routing_lock:
+            req = self.routing.pop(request_id, None)
+        if req is None:
+            raise KeyError(f"no held exchange for request {request_id!r}")
+        req.stream = Queue()
+        req.stream_headers = dict(headers or {})
+        req.done.set()
+        return StreamWriter(self, req)
+
     def reply_to(self, request_id: str, response: HTTPResponseData):
         """HTTPSinkV2 replyTo: answer over the held exchange."""
         with self._routing_lock:
@@ -249,6 +315,42 @@ class WorkerServer:
             # 504 timeout popped it): the model DID process the request,
             # and an un-journaled reply would replay it after restart
             self.journal.log_reply(request_id)
+
+
+class StreamWriter:
+    """Handle returned by WorkerServer.stream_to: chunk sink for one held
+    exchange.  Thread-safe hand-off via the request's queue; the handler
+    thread owns the socket."""
+
+    def __init__(self, server: WorkerServer, req: CachedRequest):
+        self._server = server
+        self._id = req.id
+        self._req = req
+        self._closed = False
+
+    def write(self, data: bytes):
+        if self._closed:
+            raise ValueError(f"stream for {self._id!r} is closed")
+        if self._req.handler_gone.is_set():
+            # disconnect or handler timeout: fail the producer loop instead
+            # of queueing tokens nobody will read
+            raise BrokenPipeError(
+                f"client for stream {self._id!r} is gone")
+        self._req.stream.put(bytes(data))
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._req.stream.put(None)
+        if self._server.journal is not None:
+            self._server.journal.log_reply(self._id)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def parse_request(batch: List[CachedRequest],

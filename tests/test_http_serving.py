@@ -286,3 +286,110 @@ def test_port_forwarding_command():
     assert cmd[-1] == "svc@bastion.example.com"
     cmd2 = forwarding_command("h", 9000, 5001, reverse=False)
     assert "-L" in cmd2 and "5001:127.0.0.1:9000" in cmd2
+
+
+def test_streaming_reply_chunks_arrive_incrementally():
+    # stream_to: the client must see the first chunk BEFORE the writer
+    # closes the stream — buffered-until-close would deadlock this test
+    # (guarded by timeouts), and the final payload must concatenate all
+    # chunks. Beyond-reference: replyTo is single-shot in the reference.
+    import http.client
+    import threading
+
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    server = WorkerServer("stream-test", path="/gen")
+    server.start()
+    got_first = threading.Event()
+    worker_done = threading.Event()
+
+    def worker():
+        batch = server.get_batch(max_batch=1, timeout_ms=5000)
+        assert batch
+        with server.stream_to(batch[0].id,
+                              headers={"Content-Type": "text/plain"}) as w:
+            w.write(b"tok1 ")
+            # wait until the CLIENT has read the first chunk: proves
+            # incremental delivery, not buffer-at-close
+            assert got_first.wait(10), "client never saw the first chunk"
+            w.write(b"tok2 ")
+            w.write(b"tok3")
+        worker_done.set()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        info = server.service_info
+        conn = http.client.HTTPConnection(info.host, info.port, timeout=10)
+        conn.request("POST", "/gen", body=b"{}")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/plain"
+        first = resp.read(5)
+        assert first == b"tok1 "
+        got_first.set()
+        rest = resp.read()
+        assert rest == b"tok2 tok3"
+        assert worker_done.wait(10)
+        # chunked framing terminated cleanly: the keep-alive connection
+        # serves another (normal, single-shot) request afterwards
+        def answer_one():
+            b2 = server.get_batch(max_batch=1, timeout_ms=5000)
+            from mmlspark_tpu.io.http.schema import HTTPResponseData
+            server.reply_to(b2[0].id, HTTPResponseData(200, entity=b"plain"))
+
+        t2 = threading.Thread(target=answer_one, daemon=True)
+        t2.start()
+        conn.request("POST", "/gen", body=b"{}")
+        resp2 = conn.getresponse()
+        assert resp2.read() == b"plain"
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_streaming_writer_fails_fast_after_client_disconnect():
+    # the producer must get BrokenPipeError once the handler is gone —
+    # not silently queue tokens nobody reads
+    import http.client
+    import threading
+    import time
+
+    import pytest
+
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    server = WorkerServer("stream-dead", path="/gen")
+    server.start()
+    writer_box = {}
+    started = threading.Event()
+
+    def worker():
+        batch = server.get_batch(max_batch=1, timeout_ms=5000)
+        writer_box["w"] = server.stream_to(batch[0].id)
+        writer_box["w"].write(b"first")
+        started.set()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        info = server.service_info
+        conn = http.client.HTTPConnection(info.host, info.port, timeout=10)
+        conn.request("POST", "/gen", body=b"{}")
+        resp = conn.getresponse()
+        assert resp.read(5) == b"first"
+        assert started.wait(10)
+        conn.close()  # client walks away mid-stream
+        # the handler notices on its next flush attempt; the writer must
+        # start refusing within a bounded window
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                writer_box["w"].write(b"more")
+                time.sleep(0.05)
+            except BrokenPipeError:
+                break
+        else:
+            pytest.fail("writer never noticed the dead client")
+    finally:
+        server.stop()
