@@ -104,3 +104,38 @@ try:
     print("served completions continue the learned sequence")
 finally:
     query.stop()
+
+# ---- and the same model as a token-streaming endpoint -------------------
+# stream_reply flushes each chunk to the client as it is produced
+# (Transfer-Encoding: chunked over the held exchange)
+
+
+def stream_tokens(row):
+    toks = jnp.asarray(np.asarray(row["prompt"], np.int32))[None]
+    out = np.asarray(generate(model, variables, toks, max_new_tokens=8))
+    for t in out[0, toks.shape[1]:]:
+        yield f"{int(t)} "
+
+
+squery = (read_stream()
+          .continuous_server(name="lm-stream", path="/stream")
+          .parse_request(schema=["prompt"])
+          .stream_reply(stream_tokens)
+          .options(batch_timeout_ms=5.0)
+          .start())
+try:
+    import http.client
+
+    info = squery.service_info
+    conn = http.client.HTTPConnection(info.host, info.port, timeout=30)
+    conn.request("POST", "/stream", body=json.dumps(
+        {"prompt": [20, 21, 22, 23]}).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    streamed = [int(t) for t in resp.read().decode().split()]
+    conn.close()
+    print(f"streamed completion: {streamed}")
+    assert streamed == [(24 + i) % VOCAB for i in range(8)], streamed
+    print("token-streaming endpoint serves the same weights")
+finally:
+    squery.stop()

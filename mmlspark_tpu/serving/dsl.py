@@ -88,6 +88,8 @@ class StreamReader:
         self._batch_timeout_ms = 10.0
         self._trigger_interval_ms = 20.0
         self._journal_path: Optional[str] = None
+        self._stream_fn = None
+        self._stream_workers = 8
 
     # ---- sources (IOImplicits server/distributedServer/continuousServer)
     def server(self, host: str = "127.0.0.1", port: int = 0,
@@ -138,13 +140,22 @@ class StreamReader:
         self._reply_col = reply_col
         return self
 
+    def stream_reply(self, fn) -> "StreamReader":
+        """Streaming sink (replaces transform+make_reply): `fn(row) ->
+        iterable of str/bytes` chunks, flushed to the client as produced —
+        the token-by-token generation shape.  At-most-once delivery."""
+        self._stream_fn = fn
+        return self
+
     def options(self, max_batch: Optional[int] = None,
                 batch_timeout_ms: Optional[float] = None,
                 trigger_interval_ms: Optional[float] = None,
-                journal_path: Optional[str] = None) -> "StreamReader":
+                journal_path: Optional[str] = None,
+                stream_workers: Optional[int] = None) -> "StreamReader":
         """journal_path is the `checkpointLocation` analog: accepted
         requests survive process restart (replicas > 1 each get their own
-        `<path>-<replica>` file)."""
+        `<path>-<replica>` file).  stream_workers sizes the stream_reply
+        producer pool (concurrent generations per replica)."""
         if max_batch is not None:
             self._max_batch = int(max_batch)
         if batch_timeout_ms is not None:
@@ -153,17 +164,23 @@ class StreamReader:
             self._trigger_interval_ms = float(trigger_interval_ms)
         if journal_path is not None:
             self._journal_path = journal_path
+        if stream_workers is not None:
+            self._stream_workers = int(stream_workers)
         return self
 
     # ---- sink ----------------------------------------------------------
     def start(self) -> StreamingQuery:
-        if self._model is None or self._reply_col is None:
+        if self._stream_fn is None and (
+                self._model is None or self._reply_col is None):
             raise ValueError("streaming query needs .transform(model) and "
-                             ".make_reply(col) before start()")
+                             ".make_reply(col) — or .stream_reply(fn) — "
+                             "before start()")
         servers = []
         for r in range(self._replicas):
             srv = ServingServer(
                 model=self._model, reply_col=self._reply_col,
+                stream_fn=self._stream_fn,
+                stream_workers=self._stream_workers,
                 name=self._name if self._replicas == 1
                 else f"{self._name}-{r}",
                 host=self._host, port=self._port, path=self._path,

@@ -19,6 +19,7 @@ import json
 import threading
 import time
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
@@ -432,17 +433,32 @@ class ServingServer:
     the loop and calls `recover()` (the Spark task-retry analog).
     """
 
-    def __init__(self, model, reply_col: str, name: str = "serving",
+    def __init__(self, model, reply_col: Optional[str] = None,
+                 name: str = "serving",
                  host: str = "127.0.0.1", port: int = 0, path: str = "/",
                  input_schema: Optional[List[str]] = None,
                  max_batch: int = 64, batch_timeout_ms: float = 10.0,
                  max_attempts: int = 2, mode: str = "continuous",
                  trigger_interval_ms: float = 20.0,
-                 journal_path: Optional[str] = None):
+                 journal_path: Optional[str] = None,
+                 stream_fn: Optional[Any] = None,
+                 stream_workers: int = 8):
         if mode not in ("continuous", "microbatch"):
             raise ValueError("mode must be 'continuous' or 'microbatch'")
+        if stream_fn is None and (model is None or reply_col is None):
+            raise ValueError("need model + reply_col, or stream_fn")
         self.model = model
         self.reply_col = reply_col
+        # streaming mode: per-request `fn(row) -> iterable of str/bytes`
+        # chunks, delivered incrementally over the held exchange
+        # (WorkerServer.stream_to).  At-most-once; runs on a pool
+        # (`stream_workers` wide) so one slow generation doesn't stall
+        # the intake loop.
+        self.stream_fn = stream_fn
+        self._stream_pool = (
+            ThreadPoolExecutor(max_workers=int(stream_workers),
+                               thread_name_prefix=f"stream-{name}")
+            if stream_fn is not None else None)
         self.input_schema = input_schema
         self.max_batch = int(max_batch)
         self.batch_timeout_ms = float(batch_timeout_ms)
@@ -478,6 +494,23 @@ class ServingServer:
             if not batch:
                 self.server.commit(epoch)  # empty epochs GC immediately
                 continue
+            if self.stream_fn is not None:
+                # rows come straight from each request's JSON body: the
+                # columnar parse would coerce types batch-dependently (a
+                # lone list becomes an ndarray slice; co-batched ragged
+                # lists stay lists) — stream_fn must see stable types
+                for req in batch:
+                    try:
+                        row = json.loads(req.request.entity or b"{}")
+                    except json.JSONDecodeError:
+                        row = {}
+                    if self.input_schema is not None:
+                        row = {k: row.get(k) for k in self.input_schema}
+                    self._stream_pool.submit(self._stream_one, req.id, row)
+                self.stats["requests"] += len(batch)
+                self.stats["batches"] += 1
+                self.server.commit(epoch)  # at-most-once past this point
+                continue
             try:
                 table, id_col = parse_request(batch, self.input_schema)
                 out = self.model.transform(table)
@@ -501,6 +534,48 @@ class ServingServer:
                             ),
                         )
                 self.server.commit(epoch)  # requeued/answered: history done
+
+    def _stream_one(self, request_id: str, row: Dict[str, Any]):
+        """Produce one request's chunk stream on the pool.
+
+        The chunked exchange opens only once the FIRST chunk exists: a
+        stream_fn that fails before producing anything still gets a real
+        HTTP 500 (the status line isn't spent yet).  An error after the
+        first chunk can only be reported in-band; BrokenPipeError means
+        the client left — stop generating."""
+        def enc(c):
+            return c.encode("utf-8") if isinstance(c, str) else c
+
+        try:
+            it = iter(self.stream_fn(row))
+            first = next(it, None)
+        except Exception as e:  # noqa: BLE001 — pre-stream failure: real 500
+            self.stats["errors"] += 1
+            self.server.reply_to(request_id, HTTPResponseData(
+                500, "stream error", {},
+                json.dumps({"error": str(e)}).encode()))
+            return
+        try:
+            writer = self.server.stream_to(
+                request_id,
+                headers={"Content-Type": "text/plain; charset=utf-8"})
+        except KeyError:
+            return  # handler timed out and dropped the exchange
+        try:
+            if first is not None:
+                writer.write(enc(first))
+            for chunk in it:
+                writer.write(enc(chunk))
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — serving must survive
+            self.stats["errors"] += 1
+            try:
+                writer.write(json.dumps({"error": str(e)}).encode())
+            except BrokenPipeError:
+                pass
+        finally:
+            writer.close()
 
     def _supervise(self):
         """Restart a dead consumer and replay its uncommitted epochs —
@@ -531,6 +606,11 @@ class ServingServer:
             self._worker.join(timeout=5)
         if self._supervisor is not None:
             self._supervisor.join(timeout=5)
+        if self._stream_pool is not None:
+            # don't wait on in-flight generations: their writers fail fast
+            # once the handlers go away, and queued tasks are cancelled so
+            # non-daemon pool threads can't block interpreter exit
+            self._stream_pool.shutdown(wait=False, cancel_futures=True)
         self.server.stop()
         if self.journal is not None:
             self.journal.close()

@@ -393,3 +393,83 @@ def test_streaming_writer_fails_fast_after_client_disconnect():
             pytest.fail("writer never noticed the dead client")
     finally:
         server.stop()
+
+
+def test_dsl_stream_reply_end_to_end():
+    # read_stream().stream_reply(fn): per-request chunk generator served
+    # over the continuous-batching loop, chunks visible incrementally
+    import http.client
+    import threading
+
+    from mmlspark_tpu.serving import read_stream
+
+    release = threading.Event()
+
+    def complete(row):
+        prompt = str(row["prompt"])
+        yield f"{prompt}:"
+        yield "tok1 "
+        assert release.wait(10), "client never read the early chunks"
+        yield "tok2"
+
+    query = (read_stream()
+             .continuous_server(name="stream-dsl", path="/gen")
+             .parse_request(schema=["prompt"])
+             .stream_reply(complete)
+             .options(batch_timeout_ms=5.0)
+             .start())
+    try:
+        info = query.service_info
+        conn = http.client.HTTPConnection(info.host, info.port, timeout=10)
+        conn.request("POST", "/gen", body=b'{"prompt": "hi"}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        head = resp.read(8)
+        assert head == b"hi:tok1 "
+        release.set()
+        assert resp.read() == b"tok2"
+        # a second request on the same connection (keep-alive intact)
+        release.set()
+        conn.request("POST", "/gen", body=b'{"prompt": "yo"}')
+        assert conn.getresponse().read() == b"yo:tok1 tok2"
+        conn.close()
+        assert query.stats["requests"] == 2
+    finally:
+        query.stop()
+
+
+def test_stream_reply_prestream_error_is_real_500():
+    # stream_fn failing BEFORE its first chunk must surface as HTTP 500
+    # (the status line isn't spent yet) — and the row types stream_fn sees
+    # come straight from the request JSON, not batch-dependent coercion
+    import http.client
+
+    from mmlspark_tpu.serving import read_stream
+
+    def complete(row):
+        assert isinstance(row["prompt"], list), type(row["prompt"])
+        if row["prompt"] == ["boom"]:
+            raise RuntimeError("bad prompt")
+        yield "ok:" + str(len(row["prompt"]))
+
+    query = (read_stream()
+             .continuous_server(name="stream-err", path="/gen")
+             .parse_request(schema=["prompt"])
+             .stream_reply(complete)
+             .options(batch_timeout_ms=5.0, stream_workers=2)
+             .start())
+    try:
+        info = query.service_info
+        conn = http.client.HTTPConnection(info.host, info.port, timeout=10)
+        conn.request("POST", "/gen", body=b'{"prompt": ["boom"]}')
+        resp = conn.getresponse()
+        assert resp.status == 500
+        assert b"bad prompt" in resp.read()
+        conn.request("POST", "/gen", body=b'{"prompt": [1, 2, 3]}')
+        resp2 = conn.getresponse()
+        assert resp2.status == 200
+        assert resp2.read() == b"ok:3"
+        conn.close()
+    finally:
+        query.stop()
