@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from .transformer import TransformerLM
 
-__all__ = ["generate"]
+__all__ = ["generate", "beam_search"]
 
 
 def _filter_logits(lg: jnp.ndarray, top_k: Optional[int],
@@ -43,6 +43,46 @@ def _filter_logits(lg: jnp.ndarray, top_k: Optional[int],
         cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)[..., None]
         lg = jnp.where(lg < cutoff, -jnp.inf, lg)
     return lg
+
+
+def _prefill_cache(model: TransformerLM, variables, prompt: jnp.ndarray,
+                   kv_cache_dtype: Optional[str] = None):
+    """One prefill forward; returns (logits, per-layer KV cache padded to
+    [B, max_len, ...]).  The cache is the 2-tuple (k, v) form, or the
+    4-tuple int8 form (kq, ks, vq, vs) when kv_cache_dtype="int8"
+    (ops/quant.quantize_kv_row; unwritten positions stay (0 * 0-scale)=0
+    and are masked out of the softmax by the <= pos validity check)."""
+    b, s_p = prompt.shape
+    h, d = model.num_heads, model.embed_dim // model.num_heads
+    # drop any stale 'kvcache' collection captured at init time — sow
+    # would try to append to it at the init shapes otherwise
+    variables = {c: v for c, v in variables.items() if c != "kvcache"}
+    (logits, _taps), kv = model.apply(variables, prompt, train=False,
+                                      mutable=["kvcache"])
+    cache = []
+    for i in range(model.num_layers):
+        layer = kv["kvcache"][f"block{i}"]
+        k, v = layer["k"][0], layer["v"][0]          # [B, S_p, H, D]
+        if kv_cache_dtype == "int8":
+            from ..ops.quant import quantize_kv_row
+
+            kq, ks = quantize_kv_row(k)
+            vq, vs = quantize_kv_row(v)
+            cache.append((
+                jnp.zeros((b, model.max_len, h, d), jnp.int8)
+                .at[:, :s_p].set(kq),
+                jnp.zeros((b, model.max_len, h), jnp.float32)
+                .at[:, :s_p].set(ks),
+                jnp.zeros((b, model.max_len, h, d), jnp.int8)
+                .at[:, :s_p].set(vq),
+                jnp.zeros((b, model.max_len, h), jnp.float32)
+                .at[:, :s_p].set(vs),
+            ))
+        else:
+            kc = jnp.zeros((b, model.max_len, h, d), k.dtype).at[:, :s_p].set(k)
+            vc = jnp.zeros((b, model.max_len, h, d), v.dtype).at[:, :s_p].set(v)
+            cache.append((kc, vc))
+    return logits, tuple(cache)
 
 
 def generate(model: TransformerLM, variables, prompt: jnp.ndarray,
@@ -78,40 +118,8 @@ def generate(model: TransformerLM, variables, prompt: jnp.ndarray,
     if max_new_tokens < 1:
         return prompt
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    h, d = model.num_heads, model.embed_dim // model.num_heads
-
-    # ---- prefill: one forward, K/V sown per layer -----------------------
-    # (drop any stale 'kvcache' collection captured at init time — sow
-    # would try to append to it at the init shapes otherwise)
+    logits, cache = _prefill_cache(model, variables, prompt, kv_cache_dtype)
     variables = {c: v for c, v in variables.items() if c != "kvcache"}
-    (logits, _taps), kv = model.apply(variables, prompt, train=False,
-                                      mutable=["kvcache"])
-    cache = []
-    for i in range(model.num_layers):
-        layer = kv["kvcache"][f"block{i}"]
-        k, v = layer["k"][0], layer["v"][0]          # [B, S_p, H, D]
-        if kv_cache_dtype == "int8":
-            from ..ops.quant import quantize_kv_row
-
-            kq, ks = quantize_kv_row(k)
-            vq, vs = quantize_kv_row(v)
-            # unwritten positions stay (0 * 0-scale) = 0 and are masked
-            # out of the softmax by the <= pos validity check anyway
-            cache.append((
-                jnp.zeros((b, model.max_len, h, d), jnp.int8)
-                .at[:, :s_p].set(kq),
-                jnp.zeros((b, model.max_len, h), jnp.float32)
-                .at[:, :s_p].set(ks),
-                jnp.zeros((b, model.max_len, h, d), jnp.int8)
-                .at[:, :s_p].set(vq),
-                jnp.zeros((b, model.max_len, h), jnp.float32)
-                .at[:, :s_p].set(vs),
-            ))
-        else:
-            kc = jnp.zeros((b, model.max_len, h, d), k.dtype).at[:, :s_p].set(k)
-            vc = jnp.zeros((b, model.max_len, h, d), v.dtype).at[:, :s_p].set(v)
-            cache.append((kc, vc))
-    cache = tuple(cache)
 
     def sample(lg, key):
         if temperature == 0.0:
@@ -143,3 +151,131 @@ def generate(model: TransformerLM, variables, prompt: jnp.ndarray,
         last = jnp.where(done, eos_id, last)
     toks = jnp.concatenate([toks, last[None]], axis=0)
     return jnp.concatenate([prompt, toks.T], axis=1)
+
+
+def beam_search(model: TransformerLM, variables, prompt: jnp.ndarray,
+                max_new_tokens: int, num_beams: int = 4,
+                length_penalty: float = 1.0,
+                eos_id: Optional[int] = None,
+                kv_cache_dtype: Optional[str] = None) -> jnp.ndarray:
+    """Beam-search decode: prompt [B, S_p] -> [B, S_p + max_new_tokens].
+
+    TPU-shaped like `generate`: ONE prefill forward (on B rows, cache then
+    tiled to B*K) and ONE `lax.scan` over the new tokens.  Every step is
+    static-shape: score accumulation is a [B, K*V] top-k, beam reordering
+    is a batched gather of the KV cache, and finished beams (`eos_id`)
+    are frozen by restricting their continuations to eos at zero cost.
+
+    Hypotheses are ranked by score / len**length_penalty (GNMT
+    normalization; 0.0 = raw sum of logprobs).  Because mid-search
+    pruning is by RAW score, a finished hypothesis can be displaced from
+    the live beam by longer continuations — every beam that finishes is
+    therefore also recorded in a per-row best-finished buffer, and the
+    final answer is the better of (best live, best finished).
+    """
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    b, s_p = prompt.shape
+    k_beams = int(num_beams)
+    n = int(max_new_tokens)
+    if s_p + n > model.max_len:
+        raise ValueError(
+            f"prompt {s_p} + {n} new tokens exceeds max_len {model.max_len}")
+    if n < 1:
+        return prompt
+    v_size = model.vocab_size
+    pen = jnp.float32(length_penalty)
+
+    logits, cache = _prefill_cache(model, variables, prompt, kv_cache_dtype)
+    variables = {c: v for c, v in variables.items() if c != "kvcache"}
+    # tile each row's cache across its K beams: rows order [b0 b0 ... b1 ...]
+    cache = jax.tree.map(lambda c: jnp.repeat(c, k_beams, axis=0), cache)
+
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+    cur_logp = jnp.repeat(logp0[:, None], k_beams, axis=1)         # [B, K, V]
+    # only beam 0 is live initially, so the first top-k picks K DISTINCT
+    # first tokens instead of K copies of the argmax
+    scores = jnp.full((b, k_beams), -jnp.inf).at[:, 0].set(0.0)
+    seqs = jnp.zeros((b, k_beams, n), jnp.int32)
+    done = jnp.zeros((b, k_beams), bool)
+    gen_len = jnp.zeros((b, k_beams), jnp.int32)
+    best_norm = jnp.full((b,), -jnp.inf)       # finished-hypotheses buffer
+    best_seq = jnp.zeros((b, n), jnp.int32)
+    rows = jnp.arange(b)[:, None]                                  # [B, 1]
+
+    def select(scores, seqs, done, gen_len, cur_logp, t):
+        """One beam expansion: [B, K*V] top-k + state reorder at step t."""
+        logp = cur_logp
+        if eos_id is not None:
+            # finished beams may only continue with eos, at zero cost
+            frozen = jnp.full((v_size,), -jnp.inf).at[eos_id].set(0.0)
+            logp = jnp.where(done[..., None], frozen[None, None], logp)
+        cand = scores[..., None] + logp                    # [B, K, V]
+        vals, idx = jax.lax.top_k(cand.reshape(b, -1), k_beams)
+        beam = idx // v_size                               # [B, K]
+        tok = (idx % v_size).astype(jnp.int32)
+        seqs = seqs[rows, beam].at[:, :, t].set(tok)
+        prev_done = done[rows, beam]
+        gen_len = gen_len[rows, beam]
+        if eos_id is not None:
+            gen_len = jnp.where(prev_done, gen_len, t + 1)
+            newly = ~prev_done & (tok == eos_id)
+            done = prev_done | newly
+        else:
+            gen_len = jnp.full_like(gen_len, t + 1)
+            newly = jnp.zeros_like(prev_done)
+            done = prev_done
+        return vals, seqs, done, gen_len, beam, newly
+
+    def update_finished(best_norm, best_seq, scores, seqs, gen_len, newly):
+        norm = scores / jnp.maximum(gen_len, 1).astype(jnp.float32) ** pen
+        cand = jnp.where(newly, norm, -jnp.inf)            # [B, K]
+        arg = jnp.argmax(cand, axis=1)
+        cand_best = jnp.take_along_axis(cand, arg[:, None], axis=1)[:, 0]
+        better = cand_best > best_norm
+        best_norm = jnp.where(better, cand_best, best_norm)
+        best_seq = jnp.where(better[:, None],
+                             seqs[jnp.arange(b), arg], best_seq)
+        return best_norm, best_seq
+
+    def body(carry, t):
+        (cache, scores, seqs, done, gen_len, cur_logp,
+         best_norm, best_seq) = carry
+        scores, seqs, done, gen_len, beam, newly = select(
+            scores, seqs, done, gen_len, cur_logp, t)
+        best_norm, best_seq = update_finished(
+            best_norm, best_seq, scores, seqs, gen_len, newly)
+        flat_sel = (rows * k_beams + beam).reshape(-1)     # [B*K]
+        cache = jax.tree.map(lambda c: jnp.take(c, flat_sel, axis=0), cache)
+        tok = seqs[:, :, t]
+        lg, cache = model.apply(variables, tok.reshape(-1, 1), cache,
+                                s_p + t, method=model.decode_step)
+        cur_logp = jax.nn.log_softmax(
+            lg[:, 0].astype(jnp.float32)).reshape(b, k_beams, v_size)
+        return (cache, scores, seqs, done, gen_len, cur_logp,
+                best_norm, best_seq), None
+
+    # scan n-1 steps; the FINAL expansion needs no decode_step after it
+    # (a forward whose logits nobody reads — same shape as `generate`)
+    (cache, scores, seqs, done, gen_len, cur_logp,
+     best_norm, best_seq), _ = jax.lax.scan(
+        body, (cache, scores, seqs, done, gen_len, cur_logp,
+               best_norm, best_seq), jnp.arange(n - 1))
+    scores, seqs, done, gen_len, _beam, newly = select(
+        scores, seqs, done, gen_len, cur_logp, n - 1)
+    best_norm, best_seq = update_finished(
+        best_norm, best_seq, scores, seqs, gen_len, newly)
+
+    live_norm = scores / jnp.maximum(gen_len, 1).astype(jnp.float32) ** pen
+    live_arg = jnp.argmax(live_norm, axis=1)
+    live_best = jnp.take_along_axis(live_norm, live_arg[:, None],
+                                    axis=1)[:, 0]
+    live_seq = seqs[jnp.arange(b), live_arg]
+    out = jnp.where((best_norm > live_best)[:, None], best_seq, live_seq)
+    if eos_id is not None:
+        # buffered hypotheses snapshot the seq at finish time, leaving
+        # unwritten zeros past the eos — pad the dead tail with eos so
+        # every returned row reads "...tokens, eos, eos, ..."
+        seen = jnp.cumsum(out == eos_id, axis=1) > 0
+        out = jnp.where(seen, eos_id, out)
+    return jnp.concatenate([prompt, out], axis=1)
