@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from .transformer import TransformerLM
 
-__all__ = ["generate", "beam_search"]
+__all__ = ["generate", "beam_search", "speculative_generate"]
 
 
 def _filter_logits(lg: jnp.ndarray, top_k: Optional[int],
@@ -279,3 +279,115 @@ def beam_search(model: TransformerLM, variables, prompt: jnp.ndarray,
         seen = jnp.cumsum(out == eos_id, axis=1) > 0
         out = jnp.where(seen, eos_id, out)
     return jnp.concatenate([prompt, out], axis=1)
+
+
+def speculative_generate(model: TransformerLM, variables,
+                         draft_model: TransformerLM, draft_variables,
+                         prompt: jnp.ndarray, max_new_tokens: int,
+                         gamma: int = 4,
+                         eos_id: Optional[int] = None,
+                         return_stats: bool = False):
+    """Greedy speculative decoding: a cheap draft proposes `gamma` tokens
+    per round, the target verifies them all in ONE block `decode_step`
+    (K/V written speculatively; rejected positions stay masked garbage
+    the next round overwrites).  Output is EXACTLY the target's greedy
+    decode — the draft only changes how many target forwards it takes,
+    per round: 1 target block forward for up to gamma+1 emitted tokens.
+
+    B must be 1 (per-row acceptance counts diverge cache positions;
+    serving decodes one stream per call anyway).  The models must share
+    a vocabulary; the draft is typically a smaller/int8 variant.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative_generate supports batch size 1 "
+                         f"(got {prompt.shape[0]}); decode streams "
+                         "independently in serving")
+    if draft_model.vocab_size != model.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    n = int(max_new_tokens)
+    s_p = prompt.shape[1]
+    g = int(gamma)
+    if s_p + n > model.max_len:
+        raise ValueError(
+            f"prompt {s_p} + {n} new tokens exceeds max_len {model.max_len}")
+    if n < 1:
+        return prompt
+    # the verify block may run up to g ahead of the emitted length
+    if s_p + n + g > model.max_len or s_p + n + g > draft_model.max_len:
+        raise ValueError(
+            f"speculative decode needs max_len >= prompt + new + gamma "
+            f"({s_p}+{n}+{g}) on both models")
+
+    t_logits, t_cache = _prefill_cache(model, variables, prompt)
+    d_logits, d_cache = _prefill_cache(draft_model, draft_variables, prompt)
+    variables = {c: v for c, v in variables.items() if c != "kvcache"}
+    draft_variables = {c: v for c, v in draft_variables.items()
+                       if c != "kvcache"}
+
+    # the first token comes straight from the target's prefill logits:
+    # y is always "decided but not yet ingested", sitting at position p
+    y0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)   # [1]
+    out0 = jnp.zeros((n + g + 1,), jnp.int32).at[0].set(y0[0])
+
+    def draft_round(d_cache, y, p):
+        """gamma draft steps from pending token y at position p — plus one
+        EXTRA step that only exists to write d_g's K/V at p+g: on a fully
+        accepted round the next pending position is p+g+1, and without
+        this write the draft cache would keep prefill zeros at p+g
+        forever (an unmasked hole every later draft query attends over,
+        silently degrading acceptance).  Its proposed token is discarded;
+        partially-rejected garbage is overwritten just-in-time by the
+        next round's feeds before their queries run."""
+        def step(carry, i):
+            d_cache, tok = carry
+            lg, d_cache = draft_model.apply(
+                draft_variables, tok[:, None], d_cache, p + i,
+                method=draft_model.decode_step)
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return (d_cache, nxt), nxt[0]
+        (d_cache, _), d_toks = jax.lax.scan(
+            step, (d_cache, y), jnp.arange(g + 1))
+        return d_cache, d_toks[:g]                                # [g]
+
+    def body(carry):
+        t_cache, d_cache, y, p, out, emitted, rounds = carry
+        d_cache, d_toks = draft_round(d_cache, y, p)
+        # ONE target forward verifies y + all g draft tokens: logits[j]
+        # predicts position p+j+1
+        block = jnp.concatenate([y, d_toks])[None]                # [1, g+1]
+        lg, t_cache = model.apply(variables, block, t_cache, p,
+                                  method=model.decode_step)
+        t_pred = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)     # [g+1]
+        match = t_pred[:g] == d_toks
+        m = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((1,), bool)]))                      # 0..g
+        # emitted this round: d_1..d_m then the target's own next token
+        emit = jnp.where(jnp.arange(g + 1) < m,
+                         jnp.concatenate([d_toks, jnp.zeros((1,), jnp.int32)]),
+                         t_pred[jnp.minimum(m, g)])
+        out = jax.lax.dynamic_update_slice(out, emit, (emitted,))
+        y_next = t_pred[jnp.minimum(m, g)][None]
+        return (t_cache, d_cache, y_next, p + m + 1, out,
+                emitted + m + 1, rounds + 1)
+
+    def cond(carry):
+        emitted = carry[-2]
+        return emitted < n
+
+    (_, _, _, _, out, _, rounds) = jax.lax.while_loop(
+        cond, body, (t_cache, d_cache, y0, jnp.int32(s_p), out0,
+                     jnp.int32(1), jnp.int32(0)))
+    toks = out[:n][None]                                          # [1, n]
+    if eos_id is not None:
+        # match generate's eos freeze: everything after the first eos is eos
+        seen = jnp.cumsum(toks == eos_id, axis=1) > 0
+        toks = jnp.where(seen, eos_id, toks)
+    result = jnp.concatenate([prompt, toks], axis=1)
+    if return_stats:
+        # rounds = target forwards taken; (n-1)/rounds ~ tokens accepted
+        # per verify — THE speculative health metric (perfect draft:
+        # ceil((n-1)/(gamma+1)) rounds)
+        return result, rounds
+    return result

@@ -46,9 +46,11 @@ class _Block(nn.Module):
         """cache=None: full causal attention over x (train/score path).
 
         cache=(k_cache, v_cache) [B, max_len, H, D] with scalar `pos`:
-        single-token decode — x is [B, 1, E]; this token's K/V is written
-        at `pos` (lax.dynamic_update_slice keeps shapes static) and the
-        query attends over cache positions <= pos.  Returns (out, cache).
+        block decode — x is [B, s, E] holding tokens at positions
+        pos..pos+s-1 (s=1 is plain autoregressive decode); their K/V is
+        written at `pos` (lax.dynamic_update_slice keeps shapes static)
+        and query i attends over cache positions <= pos+i.  Returns
+        (out, cache).
 
         cache=(kq, ks, vq, vs): int8-quantized variant — kq/vq are int8
         [B, max_len, H, D] with per-row-per-head f32 scales ks/vs
@@ -93,8 +95,9 @@ class _Block(nn.Module):
                             preferred_element_type=jnp.float32)
             sc = sc * ks.transpose(0, 2, 1)[:, :, None, :]
             sc = sc / jnp.sqrt(jnp.float32(d))
-            valid = jnp.arange(kq.shape[1]) <= pos
-            sc = jnp.where(valid[None, None, None, :], sc, -jnp.inf)
+            q_pos = pos + jnp.arange(s)
+            valid = jnp.arange(kq.shape[1])[None, :] <= q_pos[:, None]
+            sc = jnp.where(valid[None, None, :, :], sc, -jnp.inf)
             p = jax.nn.softmax(sc, axis=-1)
             p = p * vs.transpose(0, 2, 1)[:, :, None, :]
             a = jnp.einsum("bhqk,bkhd->bqhd", p,
@@ -107,14 +110,15 @@ class _Block(nn.Module):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
             cache = (k_cache, v_cache)
-            # one query over the whole (static-length) cache, masked to
-            # positions <= pos: a [1, max_len] matmul per head — small,
-            # static, jit-friendly
+            # s queries over the whole (static-length) cache, each
+            # masked to its own position: an [s, max_len] matmul per
+            # head — small, static, jit-friendly
             sc = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                             preferred_element_type=jnp.float32)
             sc = sc / jnp.sqrt(jnp.float32(d))
-            valid = jnp.arange(k_cache.shape[1]) <= pos
-            sc = jnp.where(valid[None, None, None, :], sc, -jnp.inf)
+            q_pos = pos + jnp.arange(s)
+            valid = jnp.arange(k_cache.shape[1])[None, :] <= q_pos[:, None]
+            sc = jnp.where(valid[None, None, :, :], sc, -jnp.inf)
             p = jax.nn.softmax(sc, axis=-1)
             a = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype),
                            v_cache, preferred_element_type=jnp.float32)
@@ -200,16 +204,18 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def decode_step(self, token, cache, pos):
-        """One autoregressive step: token [B, 1] int32 at position `pos`
-        attends over the per-layer KV cache (written in place at `pos`).
-        Returns (logits [B, 1, V] f32, new_cache).  Parameter names/shapes
-        are identical to __call__, so one set of trained weights serves
-        both paths (models/generation.py drives this under lax.scan)."""
+        """Block decode: token [B, s] int32 at positions pos..pos+s-1
+        attends over the per-layer KV cache (written in place at `pos`);
+        s=1 is the classic autoregressive step, s>1 serves speculative
+        verification / chunked decode.  Returns (logits [B, s, V] f32,
+        new_cache).  Parameter names/shapes are identical to __call__, so
+        one set of trained weights serves both paths (models/generation.py
+        drives this under lax.scan)."""
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
                      name="tok_embed")(token)
         x = x + nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
-                         name="pos_embed")(pos[None] if jnp.ndim(pos) == 0
-                                           else pos)[None]
+                         name="pos_embed")(
+            jnp.arange(token.shape[1]) + pos)[None]
         new_cache = []
         for i in range(self.num_layers):
             x, layer_cache = _Block(

@@ -271,3 +271,69 @@ def test_beam_search_eos_freezes_finished_beams(model_and_vars):
     hits = np.flatnonzero(row2 == first)
     if hits.size:
         assert np.all(row2[hits[0]:] == first), row2
+
+
+def test_speculative_equals_target_greedy(model_and_vars):
+    # the defining property: speculative output == target-only greedy,
+    # REGARDLESS of the draft (here: a different random model, so
+    # acceptance is partial and every code path — accept, reject at 0,
+    # full-accept — gets traversed across positions)
+    from mmlspark_tpu.models.generation import speculative_generate
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    model, variables = model_and_vars
+    draft = transformer_lm(vocab_size=64, embed_dim=16, num_layers=1,
+                           num_heads=2, max_len=32, dtype=jnp.float32)
+    d_vars = draft.init({"params": jax.random.PRNGKey(9)},
+                        jnp.zeros((1, 4), jnp.int32), train=False)
+    prompt = jnp.asarray([[3, 7, 1]], jnp.int32)
+    want = generate(model, variables, prompt, max_new_tokens=9)
+    for gamma in (1, 3, 5):
+        got = speculative_generate(model, variables, draft, d_vars,
+                                   prompt, max_new_tokens=9, gamma=gamma)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # draft == target: every proposal accepted, still exact — and the
+    # round count proves it (perfect draft: ceil((n-1)/(gamma+1)) target
+    # forwards).  This also guards the draft-cache hole regression: a
+    # missing K/V write at a fully-accepted round degrades later
+    # proposals, which shows up here as extra rounds.
+    got, rounds = speculative_generate(model, variables, model, variables,
+                                       prompt, max_new_tokens=9, gamma=4,
+                                       return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(rounds) == -(-8 // 5), int(rounds)  # ceil(8/5) = 2
+    # and the whole loop jits (while_loop + nested scan + block decode)
+    jitted = jax.jit(lambda v, d, p: speculative_generate(
+        model, v, draft, d, p, 9, gamma=3))
+    np.testing.assert_array_equal(np.asarray(jitted(variables, d_vars,
+                                                    prompt)),
+                                  np.asarray(want))
+
+
+def test_speculative_eos_matches_generate(model_and_vars):
+    from mmlspark_tpu.models.generation import speculative_generate
+
+    model, variables = model_and_vars
+    prompt = jnp.asarray([[5, 2]], jnp.int32)
+    # pick eos = the 3rd greedy token so the freeze engages mid-sequence
+    plain = np.asarray(generate(model, variables, prompt, 8))
+    eos = int(plain[0, 2 + 2])
+    want = np.asarray(generate(model, variables, prompt, 8, eos_id=eos))
+    got = np.asarray(speculative_generate(model, variables, model,
+                                          variables, prompt, 8, gamma=3,
+                                          eos_id=eos))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_validates_inputs(model_and_vars):
+    import pytest
+
+    from mmlspark_tpu.models.generation import speculative_generate
+
+    model, variables = model_and_vars
+    with pytest.raises(ValueError, match="batch size 1"):
+        speculative_generate(model, variables, model, variables,
+                             jnp.zeros((2, 3), jnp.int32), 4)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(model, variables, model, variables,
+                             jnp.zeros((1, 3), jnp.int32), 4, gamma=0)
