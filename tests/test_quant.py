@@ -144,3 +144,32 @@ def test_prequantize_without_quant_layers_is_descriptive():
     v = model.init({"params": jax.random.PRNGKey(0)}, x)
     with pytest.raises(ValueError, match="no QuantDense"):
         prequantize(model, v, x)
+
+
+def test_self_speculation_int8_draft(rng):
+    # the int8 quantization of a model as ITS OWN draft: near-perfect
+    # acceptance by construction (same weights, 8-bit noise), and the
+    # output is still provably the f32 target's greedy decode
+    from mmlspark_tpu.models.generation import (generate,
+                                                speculative_generate)
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.ops.quant import prequantize
+
+    cfg = dict(vocab_size=64, embed_dim=32, num_layers=2, num_heads=2,
+               max_len=64, dtype=jnp.float32)
+    model = transformer_lm(**cfg)
+    qmodel = transformer_lm(**cfg, quant=True)
+    prompt = jnp.asarray([[5, 9, 14]], jnp.int32)
+    variables = {c: v for c, v in model.init(
+        {"params": jax.random.PRNGKey(0)}, prompt).items() if c != "kvcache"}
+    qvars = prequantize(qmodel, variables, prompt)
+    want = generate(model, variables, prompt, max_new_tokens=12)
+    got, rounds = speculative_generate(model, variables, qmodel, qvars,
+                                       prompt, max_new_tokens=12, gamma=4,
+                                       return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # 11 tokens to decode after the free prefill token; worst case 11
+    # rounds, perfect draft ceil(11/5)=3 — int8-vs-f32 noise on random
+    # weights costs a little acceptance, but it must stay far from the
+    # no-draft regime
+    assert int(rounds) <= 7, int(rounds)
