@@ -170,13 +170,28 @@ class _VowpalWabbitBase(Estimator):
             pass_fn = partial(_train_pass, loss=self._loss)
 
         t_learn0 = time.perf_counter_ns()
-        losses = []
         yj = jnp.asarray(y)
         ij = jnp.asarray(idx)
         vj = jnp.asarray(val)
-        for _ in range(int(self.num_passes)):
+        n_passes = int(self.num_passes)
+        if n_passes > 1:
+            # all passes ride ONE dispatch (a scan over the jitted pass):
+            # VW's multipass re-reads its cache file per pass; here the
+            # only per-pass cost was a host sync for the loss, and on a
+            # remote/tunneled device even that gates the loop
+            def scanned(w, g2):
+                def body(carry, _):
+                    w, g2 = carry
+                    w, g2, ls, ct = pass_fn(w, g2, ij, vj, yj, lr, l1, l2)
+                    return (w, g2), (ls, ct)
+                return jax.lax.scan(body, (w, g2), None, length=n_passes)
+
+            (w, g2), (loss_sums, counts) = jax.jit(scanned)(w, g2)
+            losses = [float(ls) / max(float(ct), 1.0)
+                      for ls, ct in zip(loss_sums, counts)]
+        else:
             w, g2, loss_sum, count = pass_fn(w, g2, ij, vj, yj, lr, l1, l2)
-            losses.append(float(loss_sum) / max(float(count), 1.0))
+            losses = [float(loss_sum) / max(float(count), 1.0)]
         t_learn = time.perf_counter_ns() - t_learn0
 
         stats = Table({
