@@ -2,3 +2,4 @@ from .value_indexer import ValueIndexer, ValueIndexerModel, IndexToValue
 from .clean_missing import CleanMissingData, CleanMissingDataModel
 from .featurize import Featurize, FeaturizeModel, DataConversion, CountSelector, CountSelectorModel
 from .text import TextFeaturizer, TextFeaturizerModel, MultiNGram, PageSplitter
+from .tokenizer import BPETokenizer, BPETokenizerModel

@@ -480,6 +480,12 @@ def _ex_text_featurizer():
     return TextFeaturizer(input_col="text", num_features=64), _text_table()
 
 
+@full("BPETokenizer")
+def _ex_bpe_tokenizer():
+    from mmlspark_tpu.featurize.tokenizer import BPETokenizer
+    return BPETokenizer(input_col="text", vocab_size=64), _text_table()
+
+
 @full("MultiNGram")
 def _ex_multingram():
     from mmlspark_tpu.featurize.text import MultiNGram
@@ -971,6 +977,7 @@ VIA_ESTIMATOR = {
     "CountSelectorModel": "CountSelector",
     "FeaturizeModel": "Featurize",
     "TextFeaturizerModel": "TextFeaturizer",
+    "BPETokenizerModel": "BPETokenizer",
     "ValueIndexerModel": "ValueIndexer",
     "GBDTClassificationModel": "GBDTClassifier",
     "GBDTRegressionModel": "GBDTRegressor",

@@ -1,0 +1,97 @@
+"""BPE tokenizer stage: training, round-trip codec, LM integration."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.featurize.tokenizer import (BPETokenizer,
+                                              BPETokenizerModel,
+                                              EOS_ID, PAD_ID, UNK_ID)
+
+CORPUS = ["the cat sat on the mat", "the dog sat on the log",
+          "a cat and a dog", "the mat and the log"] * 2
+
+
+def _fit(vocab_size=96, **kw):
+    return BPETokenizer(vocab_size=vocab_size, **kw).fit(
+        Table({"text": CORPUS}))
+
+
+def test_encode_decode_round_trip():
+    m = _fit()
+    for text in CORPUS:
+        ids = m.encode(text)
+        assert ids.dtype == np.int32
+        assert m.decode(ids) == text
+    # merges actually compress: frequent words become single tokens
+    assert len(m.encode("the the the")) < len("thethethe") + 3
+
+
+def test_specials_and_unknowns():
+    m = _fit()
+    assert (PAD_ID, UNK_ID, EOS_ID) == (0, 1, 2)
+    assert m.vocab[:3] == ["<pad>", "<unk>", "<eos>"]
+    ids = m.encode("zebra")  # 'z'/'b'/'r' never seen in CORPUS
+    assert UNK_ID in ids.tolist()
+    assert m.decode(np.asarray([PAD_ID, EOS_ID])) == ""
+
+
+def test_append_eos_and_transform():
+    m = _fit(append_eos=True)
+    out = m.transform(Table({"text": ["the cat", "a dog"]}))
+    for row in out["tokens"]:
+        assert row[-1] == EOS_ID
+    assert m.eos_id == EOS_ID
+
+
+def test_lowercase_flag():
+    m = _fit()
+    np.testing.assert_array_equal(m.encode("The CAT"), m.encode("the cat"))
+    m2 = _fit(lowercase=False)
+    assert UNK_ID in m2.encode("THE").tolist()  # uppercase never seen
+
+
+def test_vocab_size_is_respected():
+    m = _fit(vocab_size=40)
+    assert len(m.vocab) <= 40
+    # still decodes exactly (fewer merges, more base symbols per word)
+    assert m.decode(m.encode("the cat sat")) == "the cat sat"
+
+
+def test_tokens_feed_lm_training():
+    # the whole point: tokenizer output trains a TransformerLM directly
+    import optax
+
+    from mmlspark_tpu.models.training import make_lm_train_epoch
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    m = _fit(append_eos=True)
+    rows = m.transform(Table({"text": CORPUS}))["tokens"]
+    seq = 12
+    padded = np.full((len(rows), seq), PAD_ID, np.int32)
+    for i, r in enumerate(rows):
+        padded[i, :min(seq, len(r))] = r[:seq]
+    toks = jnp.asarray(padded.reshape(1, 8, seq))  # batch 8 = mesh 'data'
+    model = transformer_lm(vocab_size=len(m.vocab), embed_dim=32,
+                           num_layers=1, num_heads=2, max_len=seq,
+                           dtype=jnp.float32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, toks[0],
+                        train=False)["params"]
+    opt = optax.adam(1e-2)
+    epoch = make_lm_train_epoch(model, opt, donate=False)
+    params, _, losses = epoch(params, opt.init(params), toks)
+    assert np.all(np.isfinite(np.asarray(losses)))
+
+
+def test_pipeline_and_save_load(tmp_path):
+    from mmlspark_tpu.core.pipeline import PipelineStage
+
+    est = BPETokenizer(vocab_size=64)
+    model = est.fit(Table({"text": CORPUS}))
+    model.save(str(tmp_path / "bpe"))
+    loaded = PipelineStage.load(str(tmp_path / "bpe"))
+    assert isinstance(loaded, BPETokenizerModel)
+    text = "the cat and the dog"
+    np.testing.assert_array_equal(loaded.encode(text), model.encode(text))
