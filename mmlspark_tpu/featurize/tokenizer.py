@@ -151,14 +151,17 @@ class BPETokenizerModel(Model):
             w[best:best + 2] = [w[best] + w[best + 1]]
         return w
 
-    def encode(self, text: str) -> np.ndarray:
+    def encode(self, text: str, append_eos: bool = None) -> np.ndarray:
+        """Text -> int32 ids.  `append_eos` overrides the stage param per
+        call — generation PROMPTS must not end in <eos> even when the
+        training corpus rows do."""
         if self.lowercase:
             text = text.lower()
         t2i = self._token_to_id
         ids: List[int] = []
         for word in text.split():
             ids.extend(t2i.get(s, UNK_ID) for s in self._encode_word(word))
-        if self.append_eos:
+        if self.append_eos if append_eos is None else append_eos:
             ids.append(EOS_ID)
         return np.asarray(ids, np.int32)
 

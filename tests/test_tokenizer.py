@@ -95,3 +95,13 @@ def test_pipeline_and_save_load(tmp_path):
     assert isinstance(loaded, BPETokenizerModel)
     text = "the cat and the dog"
     np.testing.assert_array_equal(loaded.encode(text), model.encode(text))
+
+
+def test_encode_append_eos_override():
+    m = _fit(append_eos=True)
+    assert m.encode("the cat")[-1] == EOS_ID
+    # prompts for generation must be encodable WITHOUT the corpus eos
+    ids = m.encode("the cat", append_eos=False)
+    assert EOS_ID not in ids.tolist()
+    m2 = _fit(append_eos=False)
+    assert m2.encode("the cat", append_eos=True)[-1] == EOS_ID
