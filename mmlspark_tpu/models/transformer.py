@@ -26,6 +26,39 @@ import jax.numpy as jnp
 __all__ = ["TransformerLM", "transformer_lm"]
 
 
+def _cache_attention(q, k_cache, v_cache, q_pos, d,
+                     k_scale=None, v_scale=None):
+    """s queries over a [B, L, H, D] cache, query (b, i) masked to cache
+    positions <= q_pos[b, i] (q_pos broadcasts over B for the scalar-pos
+    callers).  The one score/mask/softmax implementation every decode
+    branch shares.  With k_scale/v_scale [B, L, H] the cache is int8 and
+    the per-(pos, head) scale — constant over d — is factored OUT of the
+    contractions: the dot operands stay pure int8->f32 converts (which
+    fuse into the dot's read) and the scales multiply the tiny
+    [B, H, s, L] score/prob tensors; no dequantized full-size cache is
+    ever materialized."""
+    quant = k_scale is not None
+    sc = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32) if quant else q,
+        k_cache.astype(jnp.float32) if quant else k_cache,
+        preferred_element_type=jnp.float32)
+    if quant:
+        sc = sc * k_scale.transpose(0, 2, 1)[:, :, None, :]
+    sc = sc / jnp.sqrt(jnp.float32(d))
+    valid = (jnp.arange(k_cache.shape[1])[None, None, :]
+             <= q_pos[:, :, None])                       # [B|1, s, L]
+    sc = jnp.where(valid[:, None, :, :], sc, -jnp.inf)
+    p = jax.nn.softmax(sc, axis=-1)
+    if quant:
+        p = p * v_scale.transpose(0, 2, 1)[:, :, None, :]
+        return jnp.einsum("bhqk,bkhd->bqhd", p,
+                          v_cache.astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache,
+                      preferred_element_type=jnp.float32)
+
+
 def _single_tpu() -> bool:
     """Default-attention dispatch predicate (separable so tests can force
     the Pallas branch on the CPU backend via interpret mode)."""
@@ -74,6 +107,27 @@ class _Block(nn.Module):
             # MXU at full bf16 rate; the attention fns accumulate in f32
             # via preferred_element_type with f32 softmax statistics
             a = self.attn_fn(q, k, v)
+        elif pos is not None and jnp.ndim(pos) == 1:
+            # SLOT decode (continuous batching): x is [B, 1, E], pos [B] —
+            # every slot sits at its OWN position (requests admitted at
+            # different times).  Writes are per-row scatters.
+            if len(cache) == 4:
+                raise ValueError(
+                    "slot (vector-pos) decode does not support the int8 "
+                    "KV cache yet — use the f32/bf16 cache for "
+                    "continuous batching")
+            if s != 1:
+                raise ValueError(
+                    f"slot decode is single-token (got s={s}); block "
+                    "decode needs a scalar pos")
+            k_cache, v_cache = cache
+            rows_b = jnp.arange(b)
+            k_cache = k_cache.at[rows_b, pos].set(
+                k[:, 0].astype(k_cache.dtype))
+            v_cache = v_cache.at[rows_b, pos].set(
+                v[:, 0].astype(v_cache.dtype))
+            cache = (k_cache, v_cache)
+            a = _cache_attention(q, k_cache, v_cache, pos[:, None], d)
         elif len(cache) == 4:
             from ..ops.quant import quantize_kv_row
 
@@ -85,24 +139,8 @@ class _Block(nn.Module):
             vq = jax.lax.dynamic_update_slice(vq, vnew, (0, pos, 0, 0))
             vs = jax.lax.dynamic_update_slice(vs, vsc, (0, pos, 0))
             cache = (kq, ks, vq, vs)
-            # the per-(pos, head) scale is constant over d, so it factors
-            # OUT of the contraction: the dot operands are pure int8->f32
-            # converts (which fuse into the dot's read) and the scales
-            # multiply the tiny [B, H, 1, L] score/prob tensors — no
-            # dequantized full-size f32 cache is ever materialized
-            sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                            kq.astype(jnp.float32),
-                            preferred_element_type=jnp.float32)
-            sc = sc * ks.transpose(0, 2, 1)[:, :, None, :]
-            sc = sc / jnp.sqrt(jnp.float32(d))
-            q_pos = pos + jnp.arange(s)
-            valid = jnp.arange(kq.shape[1])[None, :] <= q_pos[:, None]
-            sc = jnp.where(valid[None, None, :, :], sc, -jnp.inf)
-            p = jax.nn.softmax(sc, axis=-1)
-            p = p * vs.transpose(0, 2, 1)[:, :, None, :]
-            a = jnp.einsum("bhqk,bkhd->bqhd", p,
-                           vq.astype(jnp.float32),
-                           preferred_element_type=jnp.float32)
+            a = _cache_attention(q, kq, vq, (pos + jnp.arange(s))[None], d,
+                                 k_scale=ks, v_scale=vs)
         else:
             k_cache, v_cache = cache
             k_cache = jax.lax.dynamic_update_slice(
@@ -110,18 +148,10 @@ class _Block(nn.Module):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
             cache = (k_cache, v_cache)
-            # s queries over the whole (static-length) cache, each
-            # masked to its own position: an [s, max_len] matmul per
-            # head — small, static, jit-friendly
-            sc = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
-                            preferred_element_type=jnp.float32)
-            sc = sc / jnp.sqrt(jnp.float32(d))
-            q_pos = pos + jnp.arange(s)
-            valid = jnp.arange(k_cache.shape[1])[None, :] <= q_pos[:, None]
-            sc = jnp.where(valid[None, None, :, :], sc, -jnp.inf)
-            p = jax.nn.softmax(sc, axis=-1)
-            a = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype),
-                           v_cache, preferred_element_type=jnp.float32)
+            # s queries over the whole (static-length) cache, each masked
+            # to its own position: an [s, max_len] matmul per head
+            a = _cache_attention(q, k_cache, v_cache,
+                                 (pos + jnp.arange(s))[None], d)
         a = a.astype(self.dtype).reshape(b, s, e)
         x = x + self.dense_cls(e, use_bias=False, dtype=self.dtype,
                                name="proj")(a)
@@ -213,9 +243,12 @@ class TransformerLM(nn.Module):
         drives this under lax.scan)."""
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
                      name="tok_embed")(token)
-        x = x + nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
-                         name="pos_embed")(
-            jnp.arange(token.shape[1]) + pos)[None]
+        pe = nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
+                      name="pos_embed")
+        if jnp.ndim(pos) == 1:            # slot mode: per-row positions
+            x = x + pe(pos)[:, None]
+        else:
+            x = x + pe(jnp.arange(token.shape[1]) + pos)[None]
         new_cache = []
         for i in range(self.num_layers):
             x, layer_cache = _Block(

@@ -1,0 +1,199 @@
+"""Continuous batching for autoregressive decode.
+
+Beyond-reference serving capability (the reference batches fixed-function
+transforms; it has no decode loop at all): many concurrent generation
+streams share ONE jitted slot-decode step per token tick.  Each request
+owns a slot in a static [S, max_len, ...] KV cache; slots sit at their
+OWN positions (`decode_step` slot mode, models/transformer.py), so
+requests admit/finish independently — a new stream joins the running
+batch the tick after an old one leaves, no recompile (the vLLM-style
+continuous-batching shape, minus paging).
+
+Host loop per tick: admit pending prompts into free slots (one prefill
+forward each; its padded cache rows overwrite the slot), one batched
+decode step for ALL slots, emit each live slot's token to its stream.
+Greedy decode — the serving-stream shape; outputs are exactly
+`generate()`'s for every stream regardless of co-tenancy (tested).
+
+Compose with serving: `stream_reply(lambda row: batcher.stream_text(...))`
+gives token-by-token HTTP with cross-request batching on the device.
+"""
+from __future__ import annotations
+
+import threading
+from queue import Empty, Queue
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ContinuousBatcher", "TokenStream"]
+
+
+class TokenStream:
+    """Iterator over one request's generated token ids (host ints).
+    Blocks until tokens arrive; ends when the request finishes."""
+
+    def __init__(self):
+        self._q: "Queue[Optional[int]]" = Queue()
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            tok = self._q.get()
+            if tok is None:
+                return
+            yield tok
+
+    def tokens(self) -> List[int]:
+        """Drain the whole stream (blocking)."""
+        return list(self)
+
+
+class _Request:
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 eos_id: Optional[int]):
+        self.prompt = prompt
+        self.max_new = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.stream = TokenStream()
+        self.emitted = 0
+
+
+class ContinuousBatcher:
+    """Schedule many decode streams onto one slotted device batch.
+
+    model: a TransformerLM; variables: its weights.  `max_slots` is the
+    device batch width (a compile-time constant — one compiled step
+    serves every mix of tenants).
+    """
+
+    def __init__(self, model, variables, max_slots: int = 8,
+                 idle_sleep_s: float = 0.001):
+        self.model = model
+        self.variables = {c: v for c, v in variables.items()
+                          if c != "kvcache"}
+        self.max_slots = int(max_slots)
+        self.idle_sleep_s = float(idle_sleep_s)
+        s, L = self.max_slots, model.max_len
+        h, d = model.num_heads, model.embed_dim // model.num_heads
+        dt = jnp.float32 if model.dtype == jnp.float32 else model.dtype
+        self._cache = tuple(
+            (jnp.zeros((s, L, h, d), dt), jnp.zeros((s, L, h, d), dt))
+            for _ in range(model.num_layers))
+        self._pos = np.zeros(s, np.int32)
+        self._tok = np.zeros(s, np.int32)
+        self._live: List[Optional[_Request]] = [None] * s
+        self._pending: "Queue[_Request]" = Queue()
+        self._running = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._step = jax.jit(
+            lambda v, t, c, p: self.model.apply(
+                v, t, c, p, method=self.model.decode_step))
+        # whole-slot overwrite: a newly admitted request's padded cache
+        # rows replace slot `i` across every layer in one jitted update
+        self._load = jax.jit(
+            lambda c, rows, i: jax.tree.map(
+                lambda dst, src: dst.at[i].set(src[0].astype(dst.dtype)),
+                c, rows))
+
+    # ---- client side ---------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               eos_id: Optional[int] = None) -> TokenStream:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.model.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + {max_new_tokens} exceeds "
+                f"max_len {self.model.max_len}")
+        req = _Request(prompt, max_new_tokens, eos_id)
+        self._pending.put(req)
+        return req.stream
+
+    def stream_text(self, tokenizer, text: str,
+                    max_new_tokens: int = 32) -> Iterator[str]:
+        """serving.stream_reply-ready: text in, decoded token chunks out."""
+        ids = tokenizer.encode(text, append_eos=False)
+        for tok in self.submit(ids, max_new_tokens,
+                               eos_id=tokenizer.eos_id):
+            piece = tokenizer.decode([tok])
+            if piece:
+                yield piece + " "
+
+    # ---- scheduler loop ------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        self._running.set()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="continuous-batcher")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        # unblock any consumers still waiting on admitted streams
+        for req in self._live:
+            if req is not None:
+                req.stream._q.put(None)
+        while True:
+            try:
+                self._pending.get_nowait().stream._q.put(None)
+            except Empty:
+                break
+
+    def _admit(self, slot: int, req: _Request):
+        from ..models.generation import _prefill_cache
+
+        logits, cache = _prefill_cache(self.model, self.variables,
+                                       jnp.asarray(req.prompt[None]))
+        self._cache = self._load(self._cache, cache, slot)
+        first = int(jnp.argmax(logits[0, -1]))
+        self._live[slot] = req
+        self._pos[slot] = len(req.prompt)
+        self._tok[slot] = first
+        self._emit(slot, first)
+
+    def _emit(self, slot: int, tok: int):
+        req = self._live[slot]
+        req.emitted += 1
+        req.stream._q.put(tok)
+        done = (req.emitted >= req.max_new
+                or (req.eos_id is not None and tok == req.eos_id)
+                or int(self._pos[slot]) + 1 >= self.model.max_len)
+        if done:
+            req.stream._q.put(None)
+            self._live[slot] = None
+
+    def _loop(self):
+        while self._running.is_set():
+            # admit as many pending requests as there are free slots
+            for slot in range(self.max_slots):
+                if self._live[slot] is None:
+                    try:
+                        req = self._pending.get_nowait()
+                    except Empty:
+                        break
+                    self._admit(slot, req)
+            active = [s for s in range(self.max_slots)
+                      if self._live[s] is not None]
+            if not active:
+                try:
+                    req = self._pending.get(timeout=self.idle_sleep_s)
+                except Empty:
+                    continue
+                self._admit(0, req)
+                active = [0] if self._live[0] is not None else []
+                if not active:
+                    continue
+            # ONE batched step for every slot (free slots compute too —
+            # their pos 0 writes are dead, an admit overwrites the rows)
+            lg, self._cache = self._step(
+                self.variables, jnp.asarray(self._tok)[:, None],
+                self._cache, jnp.asarray(self._pos))
+            nxt = np.asarray(jnp.argmax(lg[:, 0], axis=-1), np.int32)
+            for slot in active:
+                self._pos[slot] += 1
+                self._tok[slot] = nxt[slot]
+                self._emit(slot, int(nxt[slot]))

@@ -337,3 +337,40 @@ def test_speculative_validates_inputs(model_and_vars):
     with pytest.raises(ValueError, match="gamma"):
         speculative_generate(model, variables, model, variables,
                              jnp.zeros((1, 3), jnp.int32), 4, gamma=0)
+
+
+def test_slot_decode_matches_scalar_decode(model_and_vars):
+    # vector-pos (slot) decode vs the scalar path, row by row: same
+    # tokens, same caches at the written positions
+    from mmlspark_tpu.models.generation import _prefill_cache
+
+    model, variables = model_and_vars
+    p1 = jnp.asarray([[1, 2, 3, 4]], jnp.int32)   # slot 0: 4 prompt toks
+    p2 = jnp.asarray([[9, 8]], jnp.int32)         # slot 1: 2 prompt toks
+    lg1, c1 = _prefill_cache(model, variables, p1)
+    lg2, c2 = _prefill_cache(model, variables, p2)
+    # pack both requests into one 2-slot cache
+    slot_cache = tuple(
+        (jnp.concatenate([k1, k2], axis=0), jnp.concatenate([v1, v2], axis=0))
+        for (k1, v1), (k2, v2) in zip(c1, c2))
+    tok1 = jnp.argmax(lg1[:, -1], -1).astype(jnp.int32)
+    tok2 = jnp.argmax(lg2[:, -1], -1).astype(jnp.int32)
+    toks = jnp.stack([tok1[0], tok2[0]])[:, None]           # [2, 1]
+    pos = jnp.asarray([4, 2], jnp.int32)
+    slot_lg, slot_cache = model.apply(variables, toks, slot_cache, pos,
+                                      method=model.decode_step)
+    # scalar references, one per request
+    ref1, c1 = model.apply(variables, tok1[:, None], c1, jnp.int32(4),
+                           method=model.decode_step)
+    ref2, c2 = model.apply(variables, tok2[:, None], c2, jnp.int32(2),
+                           method=model.decode_step)
+    np.testing.assert_allclose(np.asarray(slot_lg[0]), np.asarray(ref1[0]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(slot_lg[1]), np.asarray(ref2[0]),
+                               rtol=1e-5, atol=1e-5)
+    # written K/V match the scalar path at each slot's own position
+    for (ks, vs), (k1, v1), (k2, v2) in zip(slot_cache, c1, c2):
+        np.testing.assert_allclose(np.asarray(ks[0, 4]), np.asarray(k1[0, 4]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ks[1, 2]), np.asarray(k2[0, 2]),
+                                   rtol=1e-5, atol=1e-5)
