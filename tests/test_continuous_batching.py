@@ -90,3 +90,56 @@ def test_submit_validates(lm):
         batcher.submit([])
     with pytest.raises(ValueError, match="max_len"):
         batcher.submit([1] * 40, max_new_tokens=20)
+
+
+def test_http_stream_reply_composition(lm):
+    # the advertised serving shape: stream_reply(fn) where fn feeds the
+    # shared batcher — concurrent HTTP clients ride one device batch and
+    # each still gets exactly generate()'s tokens
+    import http.client
+    import threading
+
+    from mmlspark_tpu.serving import read_stream
+
+    model, variables = lm
+    batcher = ContinuousBatcher(model, variables, max_slots=4).start()
+
+    def complete(row):
+        toks = batcher.submit([int(t) for t in row["prompt"]],
+                              max_new_tokens=int(row["n"]))
+        for t in toks:
+            yield f"{t} "
+
+    query = (read_stream()
+             .continuous_server(name="cb", path="/gen")
+             .parse_request(schema=["prompt", "n"])
+             .stream_reply(complete)
+             .options(batch_timeout_ms=5.0)
+             .start())
+    prompts = [[3, 1, 4], [1, 5, 9, 2], [6, 5]]
+    results = [None] * len(prompts)
+
+    def client(i):
+        import json as _json
+
+        conn = http.client.HTTPConnection(query.service_info.host,
+                                          query.service_info.port,
+                                          timeout=30)
+        conn.request("POST", "/gen", body=_json.dumps(
+            {"prompt": prompts[i], "n": 5}).encode())
+        results[i] = [int(t) for t in
+                      conn.getresponse().read().decode().split()]
+        conn.close()
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        query.stop()
+        batcher.stop()
+    for p, got in zip(prompts, results):
+        assert got == _reference(model, variables, p, 5), (p, got)
