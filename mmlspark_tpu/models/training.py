@@ -178,15 +178,23 @@ def make_lm_train_epoch(
     jitted `lax.scan` — the TransformerLM counterpart of make_train_epoch
     (same reason: one dispatch per epoch keeps a remote/tunneled chip's
     per-call latency out of the loop; params/optimizer stay in HBM).
-    Loss is mean next-token cross-entropy in f32."""
+    Loss is mean next-token cross-entropy in f32, PLUS 0.01x any
+    module-sown 'losses' terms (the MoE load-balance aux) — MoE loss
+    curves are not pure cross-entropy."""
     mesh = mesh or default_mesh()
 
     def lm_step(params, opt_state, toks):
         def loss_fn(p):
-            logits, _ = model.apply({"params": p}, toks)
+            # 'losses' collects auxiliary objectives sown by modules (the
+            # MoE load-balance term); dense models sow nothing and the
+            # sum is 0
+            (logits, _), mut = model.apply({"params": p}, toks,
+                                           mutable=["losses"])
             lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
             ll = jnp.take_along_axis(lp, toks[:, 1:][..., None], axis=-1)
-            return -jnp.mean(ll)
+            aux = sum(jnp.sum(v) for v in
+                      jax.tree.leaves(mut.get("losses", {})))
+            return -jnp.mean(ll) + 0.01 * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)

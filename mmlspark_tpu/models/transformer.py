@@ -65,6 +65,63 @@ def _single_tpu() -> bool:
     return jax.default_backend() == "tpu" and jax.device_count() == 1
 
 
+class _MoEMLP(nn.Module):
+    """Switch-style top-1 mixture-of-experts MLP — the expert-parallel
+    ('ep') building block.  TPU-idiomatic dispatch: routing is one-hot
+    einsum dispatch/combine tensors (no ragged gathers; static [X, C, E]
+    expert buffers), so sharding the expert dimension of w_in/w_out over
+    a mesh axis makes XLA insert the all_to_alls — expert parallelism
+    falls out of shardings, exactly like dp/tp.
+
+    Tokens beyond an expert's capacity are dropped (their block output is
+    0 and the residual carries them — the Switch Transformer contract).
+    The load-balance aux loss (num_experts * sum(frac_tokens * mean_prob))
+    is sown into the 'losses' collection; training factories add every
+    sown loss to the objective."""
+
+    num_experts: int
+    mlp_ratio: int
+    dtype: Any
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, e = x.shape
+        nx = self.num_experts
+        # capacity binds PER ROW: a sequence's routing must not depend on
+        # its batch co-tenants (batched scoring and continuous-batching
+        # slot decode both promise row independence)
+        cap = max(1, int(self.capacity_factor * s / nx))
+        logits = nn.Dense(nx, dtype=jnp.float32,
+                          name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)                 # [B, S, X]
+        expert = jnp.argmax(probs, axis=-1)                     # [B, S]
+        gate = jnp.max(probs, axis=-1)                          # [B, S]
+        onehot = jax.nn.one_hot(expert, nx)                     # [B, S, X]
+        # position of each token in its row's expert queue; beyond-cap
+        # tokens drop
+        pos = jnp.sum(jnp.cumsum(onehot, axis=1) * onehot, axis=-1) - 1
+        keep = (pos < cap) & (pos >= 0)
+        disp = (onehot[..., None] * jax.nn.one_hot(pos, cap)[:, :, None, :]
+                * keep[..., None, None])                     # [B, S, X, C]
+        disp = disp.astype(self.dtype)
+        w_in = self.param("w_in", nn.initializers.lecun_normal(),
+                          (nx, e, self.mlp_ratio * e), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(),
+                           (nx, self.mlp_ratio * e, e), jnp.float32)
+        buf = jnp.einsum("bse,bsxc->bxce", x.astype(self.dtype), disp)
+        h = nn.gelu(jnp.einsum("bxce,xeh->bxch", buf,
+                               w_in.astype(self.dtype)))
+        y = jnp.einsum("bxch,xhe->bxce", h, w_out.astype(self.dtype))
+        out = jnp.einsum("bxce,bsxc->bse", y, disp) * gate[..., None].astype(
+            self.dtype)
+        # Switch load-balance loss: differentiable through mean_prob
+        frac = jnp.mean(onehot, axis=(0, 1))                    # [X]
+        mean_prob = jnp.mean(probs, axis=(0, 1))                # [X]
+        self.sow("losses", "moe_aux", nx * jnp.sum(frac * mean_prob))
+        return out
+
+
 class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int
@@ -73,6 +130,9 @@ class _Block(nn.Module):
     # injection point for quantized inference (ops/quant.QuantDense): same
     # param pytree as nn.Dense, so trained weights serve either class
     dense_cls: Any = nn.Dense
+    # > 0: the MLP is a switch-style mixture of that many experts
+    num_experts: int = 0
+    moe_capacity: float = 1.25
 
     @nn.compact
     def __call__(self, x, cache=None, pos=None):
@@ -156,10 +216,15 @@ class _Block(nn.Module):
         x = x + self.dense_cls(e, use_bias=False, dtype=self.dtype,
                                name="proj")(a)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        y = self.dense_cls(self.mlp_ratio * e, dtype=self.dtype,
-                           name="mlp_in")(y)
-        y = nn.gelu(y)
-        out = x + self.dense_cls(e, dtype=self.dtype, name="mlp_out")(y)
+        if self.num_experts > 0:
+            out = x + _MoEMLP(self.num_experts, self.mlp_ratio, self.dtype,
+                              capacity_factor=self.moe_capacity,
+                              name="moe")(y)
+        else:
+            y = self.dense_cls(self.mlp_ratio * e, dtype=self.dtype,
+                               name="mlp_in")(y)
+            y = nn.gelu(y)
+            out = x + self.dense_cls(e, dtype=self.dtype, name="mlp_out")(y)
         return out if cache is None else (out, cache)
 
 
@@ -186,6 +251,17 @@ class TransformerLM(nn.Module):
     # prequantize() for weight-bandwidth-bound batch-1 decode, where int8
     # weight reads are the whole game.
     quant: bool = False
+    # > 0: every block's MLP is a switch-style top-1 mixture of this many
+    # experts (expert-parallel over the mesh when w_in/w_out are sharded
+    # on their leading dim; aux load-balance loss sown as 'losses')
+    moe_experts: int = 0
+    # capacity factor: tokens per expert = cap_factor * T / experts;
+    # over-capacity tokens are dropped (residual carries them).  NOTE:
+    # capacity binds per forward call, so a full forward that drops
+    # tokens is not bit-identical to incremental decode (which never
+    # fills a 1-token step's capacity) — raise it (e.g. >= experts) for
+    # drop-free inference when decode/forward consistency matters.
+    moe_capacity: float = 1.25
     layer_names = ["logits", "pool", "hidden", "embed"]
     input_dtype = jnp.int32  # token ids (FlaxBundle auto-init dummy dtype)
 
@@ -222,7 +298,9 @@ class TransformerLM(nn.Module):
         taps["embed"] = x
         for i in range(self.num_layers):
             x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,
-                       dense_cls=self._dense_cls, name=f"block{i}")(x)
+                       dense_cls=self._dense_cls,
+                       num_experts=self.moe_experts,
+                       moe_capacity=self.moe_capacity, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         taps["hidden"] = x
         taps["pool"] = jnp.mean(x, axis=1).astype(jnp.float32)
@@ -253,7 +331,8 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x, layer_cache = _Block(
                 self.num_heads, self.mlp_ratio, self.dtype, attn_fn=None,
-                dense_cls=self._dense_cls,
+                dense_cls=self._dense_cls, num_experts=self.moe_experts,
+                moe_capacity=self.moe_capacity,
                 name=f"block{i}")(x, cache=cache[i], pos=pos)
             new_cache.append(layer_cache)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -265,10 +344,12 @@ class TransformerLM(nn.Module):
 
 def transformer_lm(vocab_size=1024, embed_dim=128, num_layers=2, num_heads=4,
                    max_len=2048, dtype=jnp.bfloat16, attn_fn=None,
-                   quant=False, num_classes=None):
+                   quant=False, moe_experts=0, moe_capacity=1.25,
+                   num_classes=None):
     """Builder (zoo registry).  `num_classes` is accepted and ignored so the
     generic builder call sites (get_builder(name)(num_classes=...)) work."""
     return TransformerLM(vocab_size=vocab_size, embed_dim=embed_dim,
                          num_layers=num_layers, num_heads=num_heads,
                          max_len=max_len, dtype=dtype, attn_fn=attn_fn,
-                         quant=quant)
+                         quant=quant, moe_experts=moe_experts,
+                         moe_capacity=moe_capacity)

@@ -134,3 +134,82 @@ def test_lm_gradients_flow(model, variables, rng):
     flat = jax.tree_util.tree_leaves(grads)
     assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
     assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+
+
+def test_moe_lm_trains_and_balances():
+    # expert-parallel building block: the switch MoE MLP routes, trains
+    # through the scanned-epoch factory (aux loss via the 'losses'
+    # collection), and spreads tokens across experts
+    import optax
+
+    from mmlspark_tpu.models.training import make_lm_train_epoch
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    model = transformer_lm(vocab_size=64, embed_dim=32, num_layers=2,
+                           num_heads=2, max_len=32, dtype=jnp.float32,
+                           moe_experts=4)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 64, size=(2, 8, 16)), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, toks[0],
+                        train=False)["params"]
+    # expert weights exist with a leading expert dim (shardable for ep)
+    assert params["block0"]["moe"]["w_in"].shape == (4, 32, 128)
+    opt = optax.adam(1e-2)
+    epoch = make_lm_train_epoch(model, opt, donate=False)
+    params, opt_state, losses = epoch(params, opt.init(params), toks)
+    assert np.all(np.isfinite(np.asarray(losses)))
+    params, _, losses2 = epoch(params, opt_state, toks)
+    assert float(losses2[-1]) < float(losses[0])  # it learns
+    # routing uses MORE than one expert on random inputs
+    x = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+    logits_r = np.asarray(x @ np.asarray(
+        params["block0"]["moe"]["router"]["kernel"])
+        + np.asarray(params["block0"]["moe"]["router"]["bias"]))
+    assert len(set(logits_r.argmax(axis=-1).tolist())) > 1
+
+
+def test_moe_decode_matches_full_forward():
+    # KV-cached decode through MoE blocks must agree with the full
+    # forward (the same greedy-vs-naive oracle as the dense model)
+    from mmlspark_tpu.models.generation import generate
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    # drop-free capacity: decode/forward consistency only holds when the
+    # full forward drops nothing (capacity binds per forward call)
+    model = transformer_lm(vocab_size=32, embed_dim=16, num_layers=1,
+                           num_heads=2, max_len=24, dtype=jnp.float32,
+                           moe_experts=2, moe_capacity=4.0)
+    prompt = jnp.asarray([[5, 3, 7]], jnp.int32)
+    variables = {c: v for c, v in model.init(
+        {"params": jax.random.PRNGKey(1)}, prompt).items()
+        if c not in ("kvcache", "losses")}
+    out = generate(model, variables, prompt, max_new_tokens=5)
+    # naive recompute oracle
+    toks = prompt
+    for _ in range(5):
+        logits, _ = model.apply(variables, toks, train=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+def test_moe_rows_are_independent_of_co_tenants():
+    # MoE capacity binds per row: a sequence's logits must not change
+    # with its batchmates (the batched-scoring / continuous-batching
+    # co-tenancy contract)
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    model = transformer_lm(vocab_size=32, embed_dim=16, num_layers=1,
+                           num_heads=2, max_len=16, dtype=jnp.float32,
+                           moe_experts=2, moe_capacity=0.5)  # tight cap
+    rng = np.random.default_rng(3)
+    row = jnp.asarray(rng.integers(0, 32, size=(1, 8)), jnp.int32)
+    other = jnp.asarray(rng.integers(0, 32, size=(3, 8)), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, row,
+                           train=False)
+    variables = {c: v for c, v in variables.items()
+                 if c not in ("kvcache", "losses")}
+    solo, _ = model.apply(variables, row)
+    batched, _ = model.apply(variables, jnp.concatenate([row, other]))
+    np.testing.assert_allclose(np.asarray(batched[0]), np.asarray(solo[0]),
+                               rtol=1e-5, atol=1e-5)
