@@ -1,0 +1,79 @@
+"""Pipeline parallelism: a GPipe schedule as shard_map + ppermute.
+
+The last of the mesh parallelisms (dp/tp/sp/ep live elsewhere): P pipeline
+stages hold their own slice of a stacked parameter pytree (leading dim P,
+sharded over a mesh axis), microbatches stream through the stage chain
+with activations hopping stage-to-stage over `ppermute` — the classic
+bubble schedule (M + P - 1 steps for M microbatches; bubble fraction
+(P-1)/(M+P-1)).
+
+TPU-first shape: ONE jitted program — the schedule is a `lax.scan`, the
+inter-stage hop is a collective XLA lowers onto ICI, and the whole thing
+is differentiable (ppermute transposes to the reverse hop), so training
+backprops through the pipe with no custom VJP.
+
+The reference has no model parallelism of any kind (SURVEY §2.10 last
+row); this is beyond-reference infrastructure shaped by the same
+mesh/collective design as the rest of `parallel/`.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree per stage] -> one pytree with leading dim P (stage axis) —
+    the layout `pipeline_apply` shards over the mesh axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x: jnp.ndarray,
+                   mesh: Mesh, axis: str = "model") -> jnp.ndarray:
+    """Run `x [M, mb, ...]` microbatches through P chained stages.
+
+    stage_fn(params_i, x) -> same-shaped activation; `stacked_params` has
+    leading dim P == mesh.shape[axis], sharded so stage i's weights live
+    on pipe rank i.  Returns [M, mb, ...] outputs (replicated), equal to
+    applying the P stages sequentially to each microbatch.
+    """
+    n_stages = mesh.shape[axis]
+    m = x.shape[0]
+    steps = m + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_stage(params_local, xs):
+        params_local = jax.tree.map(lambda a: a[0], params_local)
+        rank = jax.lax.axis_index(axis)
+        # the carry becomes device-varying after the first ppermute; the
+        # zero init must carry the same varying-axes type
+        buf = jax.lax.pcast(jnp.zeros_like(xs[0]), (axis,), to="varying")
+
+        def body(buf, t):
+            # stage 0 ingests microbatch t (while any remain); downstream
+            # stages consume what the previous stage ppermuted to them
+            inp = jnp.where(rank == 0,
+                            xs[jnp.clip(t, 0, m - 1)], buf)
+            out = stage_fn(params_local, inp)
+            nxt = jax.lax.ppermute(out, axis, perm)
+            # the LAST stage's output at step t is microbatch t-(P-1)
+            return nxt, out
+
+        _, outs = jax.lax.scan(body, buf, jnp.arange(steps))
+        # outs [steps, mb, ...]: keep the last stage's valid window and
+        # replicate it to every rank (other ranks contribute zeros)
+        window = jax.lax.dynamic_slice_in_dim(outs, n_stages - 1, m, axis=0)
+        mine = jnp.where(rank == n_stages - 1, window, 0)
+        return jax.lax.psum(mine, axis)
+
+    spec_p = jax.tree.map(lambda _: P(axis), stacked_params)
+    return shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(spec_p, P()), out_specs=P(),
+    )(stacked_params, x)
