@@ -44,6 +44,12 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jnp.ndarray,
     applying the P stages sequentially to each microbatch.
     """
     n_stages = mesh.shape[axis]
+    leading = {a.shape[0] for a in jax.tree.leaves(stacked_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stacked_params leading dim(s) {sorted(leading)} must equal "
+            f"mesh axis {axis!r} size {n_stages} — one stage per pipe "
+            "rank (a clean multiple would silently run every k-th stage)")
     m = x.shape[0]
     steps = m + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]

@@ -81,3 +81,16 @@ def test_pipeline_single_stage_degenerates():
     np.testing.assert_allclose(np.asarray(got),
                                np.asarray(_sequential(per_stage, x)),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_rejects_stage_count_mismatch():
+    import pytest
+
+    rng = np.random.default_rng(3)
+    mesh = make_mesh(data=2, model=4)
+    per_stage = _make_params(rng, 8, 4, 8)   # 8 stages on a 4-wide axis
+    x = jnp.asarray(rng.normal(size=(3, 2, 4)), jnp.float32)
+    with pytest.raises(ValueError, match="one stage per pipe rank"):
+        with MeshContext(mesh):
+            pipeline_apply(_mlp_stage, stack_stage_params(per_stage),
+                           x, mesh)
