@@ -89,10 +89,15 @@ def _step_body(model, optimizer, num_classes, seed: int = 0):
                 {"params": params, "batch_stats": state.batch_stats},
                 images,
                 train=True,
-                mutable=["batch_stats"],
+                mutable=["batch_stats", "losses"],
                 rngs={"dropout": rng},
             )
             loss = softmax_cross_entropy(logits, labels, num_classes)
+            # module-sown auxiliary objectives (MoE load balance); dense
+            # models sow nothing and the sum is 0
+            aux = sum(jnp.sum(v) for v in
+                      jax.tree.leaves(updates.get("losses", {})))
+            loss = loss + 0.01 * aux
             return loss, (logits, updates.get("batch_stats",
                                               state.batch_stats))
 

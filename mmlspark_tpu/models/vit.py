@@ -46,6 +46,9 @@ class VisionTransformer(nn.Module):
     # rate on v5e) via ops/quant.QuantDense — identical param pytree, so
     # quant=True scores weights trained with quant=False
     quant: bool = False
+    # > 0: encoder MLPs become switch-MoE (V-MoE style); expert weights
+    # shard over a mesh axis for expert parallelism
+    moe_experts: int = 0
     layer_names = ["logits", "pool", "encoded", "embed"]
 
     @nn.compact
@@ -72,7 +75,8 @@ class VisionTransformer(nn.Module):
         from ..ops.quant import dense_cls
         for i in range(self.num_layers):
             x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,
-                       dense_cls=dense_cls(self.quant), name=f"block{i}")(x)
+                       dense_cls=dense_cls(self.quant),
+                       num_experts=self.moe_experts, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         taps["encoded"] = x
         pooled = jnp.mean(x, axis=1)

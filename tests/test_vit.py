@@ -93,3 +93,30 @@ def test_deep_vision_finetunes_vit(rng):
     assert out["prediction"].shape == (12,)
     # trivially separable two-tone data: the fine-tune must fit it
     assert (out["prediction"] == np.array(labels)).mean() >= 0.9
+
+
+def test_vit_moe_variant_trains(rng):
+    # V-MoE-style encoder: switch MoE MLPs through the shared block, aux
+    # loss folded in by the training factory
+    import optax
+
+    from mmlspark_tpu.models.training import init_train_state, make_train_epoch
+    from mmlspark_tpu.models.vit import VisionTransformer
+    from mmlspark_tpu.parallel.mesh import MeshContext, make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(data=8)
+    model = VisionTransformer(patch_size=16, embed_dim=32, num_layers=1,
+                              num_heads=2, num_classes=3,
+                              dtype=jnp.float32, moe_experts=2)
+    opt = optax.adam(1e-3)
+    imgs = rng.normal(size=(1, 16, 32, 32, 3)).astype(np.float32)
+    lbls = rng.integers(0, 3, size=(1, 16)).astype(np.int32)
+    with MeshContext(mesh):
+        state = init_train_state(model, opt, (32, 32, 3), seed=0)
+        assert state.params["block0"]["moe"]["w_in"].shape == (2, 32, 128)
+        epoch = make_train_epoch(model, opt, 3, mesh=mesh, donate=False)
+        sh = NamedSharding(mesh, P(None, "data"))
+        state, ms = epoch(state, jax.device_put(imgs, sh),
+                          jax.device_put(lbls, sh))
+        assert np.all(np.isfinite(np.asarray(ms["loss"])))
