@@ -1,6 +1,5 @@
 """BPE tokenizer stage: training, round-trip codec, LM integration."""
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
