@@ -66,6 +66,8 @@ class CachedRequest:
     stream: Optional["Queue[Optional[bytes]]"] = None
     stream_headers: Optional[Dict[str, str]] = None
     handler_gone: threading.Event = field(default_factory=threading.Event)
+    # journal-recovered after a restart: no client holds this exchange
+    recovered: bool = False
 
 
 class WorkerServer:
@@ -98,7 +100,8 @@ class WorkerServer:
                 req = CachedRequest(
                     id=req_id,
                     request=HTTPRequestData(url=self.path, method="POST",
-                                            headers=headers, entity=entity))
+                                            headers=headers, entity=entity),
+                    recovered=True)
                 with self._routing_lock:
                     self.routing[req.id] = req
                 self.queue.put(req)
@@ -500,6 +503,13 @@ class ServingServer:
                 # lone list becomes an ndarray slice; co-batched ragged
                 # lists stay lists) — stream_fn must see stable types
                 for req in batch:
+                    if req.recovered:
+                        # a journal-replayed stream has NO client socket:
+                        # generating into it would be pure waste.  Streams
+                        # are at-most-once; mark replied and move on.
+                        self.server.reply_to(req.id, HTTPResponseData(
+                            410, "client gone across restart"))
+                        continue
                     try:
                         row = json.loads(req.request.entity or b"{}")
                     except json.JSONDecodeError:

@@ -229,3 +229,48 @@ def test_journal_off_by_default(tmp_path):
         assert r.ok and r.json() == {"y": 3.0}
     finally:
         srv.stop()
+
+
+def test_streamed_requests_do_not_replay_after_restart(tmp_path):
+    """Streams are at-most-once: a journaled-but-unanswered request must
+    NOT re-run stream_fn after a restart (no client holds the socket) —
+    it is marked replied so it can't replay forever."""
+    import json
+    import threading
+    import time
+
+    from mmlspark_tpu.serving.journal import EpochJournal
+    from mmlspark_tpu.serving.server import ServingServer
+
+    path = str(tmp_path / "stream.journal")
+    # simulate a crash: journal an accepted request with no reply
+    j = EpochJournal(path)
+    j.log_request("req-1", json.dumps({"prompt": "x"}).encode(), {})
+    j.close()
+
+    calls = []
+    started = threading.Event()
+
+    def fn(row):
+        calls.append(row)
+        started.set()
+        yield "never"
+
+    srv = ServingServer(model=None, stream_fn=fn, name="sj",
+                        path="/gen", journal_path=path,
+                        batch_timeout_ms=5.0)
+    srv.start()
+    try:
+        # give the loop time to drain the recovered request
+        time.sleep(0.5)
+        assert not calls, "recovered stream must not re-generate"
+        # and it is journaled as replied: a SECOND restart sees nothing
+        srv.stop()
+        j2 = EpochJournal(path)
+        assert list(j2.recovered_requests()) == []
+        j2.close()
+    finally:
+        try:
+            srv.stop()
+        except Exception:
+            pass
