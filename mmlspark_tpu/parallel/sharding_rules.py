@@ -1,0 +1,52 @@
+"""Canonical parameter-sharding rules for the model families.
+
+One place for the `(path, arr) -> PartitionSpec` functions that
+`models.training.shard_params` consumes — the graft-entry dryrun, tests,
+and user code previously each hand-rolled the same name matching.
+
+Rules return None/P() to replicate; XLA inserts the collectives implied
+by whatever they shard (tensor parallelism for block kernels, expert
+parallelism for MoE expert dims).
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["path_names", "lm_tensor_parallel_rules",
+           "moe_expert_parallel_rules", "head_rules"]
+
+
+def path_names(path):
+    """Flax/jax tree path entries -> their string names."""
+    return [getattr(p, "key", getattr(p, "name", "")) for p in path]
+
+
+def lm_tensor_parallel_rules(path, arr, axis: str = "model"):
+    """TransformerLM block/head kernels over the tensor axis: qkv/mlp_in/
+    head shard output features, proj/mlp_out shard input features (the
+    megatron pairing — one all-reduce per block, none inside the MLP)."""
+    names = path_names(path)
+    if arr.ndim == 2 and any(n in names for n in ("qkv", "mlp_in", "head")):
+        return P(None, axis)
+    if arr.ndim == 2 and any(n in names for n in ("proj", "mlp_out")):
+        return P(axis, None)
+    return P()
+
+
+def moe_expert_parallel_rules(path, arr, axis: str = "model"):
+    """Shard the EXPERT dim of switch-MoE w_in/w_out (expert parallelism);
+    everything else replicates."""
+    names = path_names(path)
+    if ("moe" in names and arr.ndim == 3
+            and any(n in names for n in ("w_in", "w_out"))):
+        return P(axis, None, None)
+    return P()
+
+
+def head_rules(path, arr, axis: str = "model"):
+    """Classifier-head-only sharding (the CNN fine-tune shape: one big
+    dense head, convs replicated)."""
+    names = path_names(path)
+    if "head" in names and arr.ndim >= 2:
+        return P(None, axis)
+    return P()

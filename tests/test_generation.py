@@ -174,29 +174,19 @@ def test_tensor_parallel_sharded_generate(model_and_vars):
     # 'model' axis and jit the whole generate — outputs must match the
     # single-placement run token for token (collectives are exact here:
     # each device holds whole output columns)
-    from jax.sharding import PartitionSpec as P
-
     from mmlspark_tpu.models.training import shard_params
     from mmlspark_tpu.parallel.mesh import MeshContext, make_mesh
+    from mmlspark_tpu.parallel.sharding_rules import lm_tensor_parallel_rules
 
     model, variables = model_and_vars
     prompt = jnp.asarray([[2, 7, 1, 8]], jnp.int32)
     base = generate(model, variables, prompt, max_new_tokens=8)
 
     mesh = make_mesh(data=1, model=8)
-
-    def rules(path, arr):
-        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-        if arr.ndim == 2 and any(n in names for n in
-                                 ("qkv", "mlp_in", "head")):
-            return P(None, "model")   # shard output features
-        if arr.ndim == 2 and any(n in names for n in ("proj", "mlp_out")):
-            return P("model", None)   # shard input features
-        return P()
-
     with MeshContext(mesh):
         sharded = dict(variables)
-        sharded["params"] = shard_params(variables["params"], mesh, rules)
+        sharded["params"] = shard_params(variables["params"], mesh,
+                                         lm_tensor_parallel_rules)
         out = jax.jit(lambda v, p: generate(
             model, v, p, 8))(sharded, prompt)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
