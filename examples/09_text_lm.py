@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import optax
 
 from mmlspark_tpu import Table
-from mmlspark_tpu.featurize.tokenizer import BPETokenizer, PAD_ID
+from mmlspark_tpu.featurize.tokenizer import (BPETokenizer, PAD_ID,
+                                              pack_sequences)
 from mmlspark_tpu.models.generation import generate
 from mmlspark_tpu.models.training import make_lm_train_epoch
 from mmlspark_tpu.models.transformer import transformer_lm
@@ -47,9 +48,7 @@ print(f"vocab={len(tok.vocab)} tokens; "
       f"'{SENTENCES[0]}' -> {rows[0].tolist()}")
 
 SEQ = max(len(r) for r in rows)
-padded = np.full((len(rows), SEQ), PAD_ID, np.int32)
-for i, r in enumerate(rows):
-    padded[i, :len(r)] = r
+padded = pack_sequences(rows, SEQ)  # mode='pack' would GPT-chunk instead
 
 # ---- train the LM on token ids ------------------------------------------
 model = transformer_lm(vocab_size=len(tok.vocab), embed_dim=48,

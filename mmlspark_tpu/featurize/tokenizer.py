@@ -24,7 +24,7 @@ from ..core.pipeline import Estimator, Model
 from ..core.registry import register_stage
 from ..core.schema import Table
 
-__all__ = ["BPETokenizer", "BPETokenizerModel"]
+__all__ = ["BPETokenizer", "BPETokenizerModel", "pack_sequences"]
 
 PAD_ID, UNK_ID, EOS_ID = 0, 1, 2
 _SPECIALS = ["<pad>", "<unk>", "<eos>"]
@@ -184,3 +184,28 @@ class BPETokenizerModel(Model):
             raise ValueError(
                 f"BPETokenizerModel: missing column '{self.input_col}'")
         return columns + [self.output_col]
+
+
+def pack_sequences(rows, seq_len: int, mode: str = "pad",
+                   pad_id: int = PAD_ID) -> np.ndarray:
+    """Ragged id arrays -> a dense [N, seq_len] int32 batch for LM training.
+
+    mode="pad": one row per sequence, truncated/padded with `pad_id` (the
+    simple fine-tuning shape).  mode="pack": all ids concatenated and
+    chunked GPT-style — no padding waste, every position trains; the tail
+    remainder pads.  Rows should already carry <eos> (append_eos=True) so
+    packed boundaries stay learnable.
+    """
+    if mode not in ("pad", "pack"):
+        raise ValueError(f"mode must be 'pad' or 'pack', got {mode!r}")
+    if mode == "pad":
+        out = np.full((len(rows), seq_len), pad_id, np.int32)
+        for i, r in enumerate(rows):
+            r = np.asarray(r, np.int32)
+            out[i, :min(seq_len, len(r))] = r[:seq_len]
+        return out
+    flat = np.concatenate([np.asarray(r, np.int32) for r in rows])
+    n = -(-len(flat) // seq_len)
+    out = np.full((n * seq_len,), pad_id, np.int32)
+    out[:len(flat)] = flat
+    return out.reshape(n, seq_len)

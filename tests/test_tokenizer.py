@@ -104,3 +104,24 @@ def test_encode_append_eos_override():
     assert EOS_ID not in ids.tolist()
     m2 = _fit(append_eos=False)
     assert m2.encode("the cat", append_eos=True)[-1] == EOS_ID
+
+
+def test_pack_sequences_modes():
+    from mmlspark_tpu.featurize.tokenizer import pack_sequences
+
+    rows = [np.asarray([5, 6, 2]), np.asarray([7, 2]),
+            np.asarray([8, 9, 10, 11, 2])]
+    padded = pack_sequences(rows, 4, mode="pad")
+    assert padded.shape == (3, 4) and padded.dtype == np.int32
+    np.testing.assert_array_equal(padded[1], [7, 2, PAD_ID, PAD_ID])
+    np.testing.assert_array_equal(padded[2], [8, 9, 10, 11])  # truncated
+    packed = pack_sequences(rows, 4, mode="pack")
+    # 10 ids -> 3 chunks of 4 with 2 pad at the tail, nothing else wasted
+    assert packed.shape == (3, 4)
+    np.testing.assert_array_equal(packed.ravel()[:10],
+                                  [5, 6, 2, 7, 2, 8, 9, 10, 11, 2])
+    assert np.all(packed.ravel()[10:] == PAD_ID)
+    import pytest
+
+    with pytest.raises(ValueError, match="mode"):
+        pack_sequences(rows, 4, mode="chunk")
