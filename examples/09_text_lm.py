@@ -24,8 +24,7 @@ import jax.numpy as jnp
 import optax
 
 from mmlspark_tpu import Table
-from mmlspark_tpu.featurize.tokenizer import (BPETokenizer, PAD_ID,
-                                              pack_sequences)
+from mmlspark_tpu.featurize.tokenizer import BPETokenizer, pack_sequences
 from mmlspark_tpu.models.generation import generate
 from mmlspark_tpu.models.training import make_lm_train_epoch
 from mmlspark_tpu.models.transformer import transformer_lm
