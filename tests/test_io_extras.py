@@ -118,3 +118,63 @@ def test_power_bi_nan_becomes_null():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_parquet_round_trip(tmp_path):
+    # dense numerics, strings, bytes, ragged arrays, and 2D features all
+    # survive Table -> parquet -> Table (the reference's storage format)
+    import numpy as np
+
+    from mmlspark_tpu import Table
+    from mmlspark_tpu.io.parquet import read_parquet, write_parquet
+
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(6, 3)).astype(np.float32)
+    ragged = np.empty(6, object)
+    for i in range(6):
+        ragged[i] = np.arange(i + 1, dtype=np.int32)
+    t = Table({
+        "x": np.arange(6, dtype=np.int64),
+        "y": rng.normal(size=6),
+        "s": np.asarray(["a", "bb", "ccc", "d", "e", "f"]),
+        "blob": np.asarray([b"\x00\x01", b"", b"zz", b"q", b"r", b"s"],
+                           dtype=object),
+        "features": feats,
+        "tokens": ragged,
+    })
+    path = str(tmp_path / "t.parquet")
+    write_parquet(t, path)
+    back = read_parquet(path)
+    assert back.num_rows == 6
+    np.testing.assert_array_equal(back["x"], t["x"])
+    np.testing.assert_allclose(back["y"], t["y"])
+    assert [str(v) for v in back["s"]] == ["a", "bb", "ccc", "d", "e", "f"]
+    assert [bytes(v) for v in back["blob"]] == [b"\x00\x01", b"", b"zz",
+                                               b"q", b"r", b"s"]
+    np.testing.assert_allclose(np.stack(back["features"]), feats)
+    for i in range(6):
+        np.testing.assert_array_equal(np.asarray(back["tokens"][i]),
+                                      ragged[i])
+    # column projection
+    sub = read_parquet(path, columns=["x", "s"])
+    assert sub.column_names == ["x", "s"]
+
+
+def test_parquet_feeds_pipeline(tmp_path):
+    # the switching-user path: data lands from parquet, trains a stage
+    import numpy as np
+
+    from mmlspark_tpu import Table
+    from mmlspark_tpu.io.parquet import read_parquet, write_parquet
+    from mmlspark_tpu.models.linear import LogisticRegression
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(40, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float64)
+    path = str(tmp_path / "train.parquet")
+    write_parquet(Table({"features": x, "label": y}), path)
+    t = read_parquet(path)
+    t = t.with_column("features", np.stack(t["features"]))
+    model = LogisticRegression(max_iter=150).fit(t)
+    out = model.transform(t)
+    assert (np.asarray(out["prediction"]) == y).mean() > 0.9
