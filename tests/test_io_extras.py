@@ -125,6 +125,8 @@ def test_parquet_round_trip(tmp_path):
     # survive Table -> parquet -> Table (the reference's storage format)
     import numpy as np
 
+    pytest.importorskip("pyarrow")
+
     from mmlspark_tpu import Table
     from mmlspark_tpu.io.parquet import read_parquet, write_parquet
 
@@ -163,6 +165,8 @@ def test_parquet_round_trip(tmp_path):
 def test_parquet_feeds_pipeline(tmp_path):
     # the switching-user path: data lands from parquet, trains a stage
     import numpy as np
+
+    pytest.importorskip("pyarrow")
 
     from mmlspark_tpu import Table
     from mmlspark_tpu.io.parquet import read_parquet, write_parquet
