@@ -59,6 +59,24 @@ def _cache_attention(q, k_cache, v_cache, q_pos, d,
                       preferred_element_type=jnp.float32)
 
 
+def _rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding: rotate [..., S, H, D] q/k by per-position
+    angles.  `positions` is [S] (shared) or [B, S] (per-row, slot decode).
+    Relative by construction — attention scores depend only on position
+    DIFFERENCES, so decode at any cache offset matches the full forward
+    (rotated keys are what the KV cache stores)."""
+    d2 = x.shape[-1] // 2
+    inv = 1.0 / (base ** (jnp.arange(d2, dtype=jnp.float32) / d2))
+    ang = positions.astype(jnp.float32)[..., None] * inv
+    if ang.ndim == 2:                      # [S, d2] -> broadcast over B
+        ang = ang[None]
+    ang = ang[:, :, None, :]               # [B|1, S, 1, d2]
+    sin, cos = jnp.sin(ang), jnp.cos(ang)
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
 def _single_tpu() -> bool:
     """Default-attention dispatch predicate (separable so tests can force
     the Pallas branch on the CPU backend via interpret mode)."""
@@ -133,6 +151,8 @@ class _Block(nn.Module):
     # > 0: the MLP is a switch-style mixture of that many experts
     num_experts: int = 0
     moe_capacity: float = 1.25
+    # rotate q/k instead of relying on learned absolute embeddings
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x, cache=None, pos=None):
@@ -158,6 +178,15 @@ class _Block(nn.Module):
         qkv = self.dense_cls(3 * e, use_bias=False, dtype=self.dtype,
                              name="qkv")(y)
         q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
+        if self.rope:
+            if cache is None:
+                rp = jnp.arange(s)
+            elif pos is not None and jnp.ndim(pos) == 1:
+                rp = pos[:, None]                  # [B, 1] slot positions
+            else:
+                rp = pos + jnp.arange(s)
+            q = _rope(q, rp)
+            k = _rope(k, rp)
         if cache is None:
             # expose this layer's K/V to generation prefill (a no-op
             # unless the caller asked for the 'kvcache' collection)
@@ -262,6 +291,9 @@ class TransformerLM(nn.Module):
     # fills a 1-token step's capacity) — raise it (e.g. >= experts) for
     # drop-free inference when decode/forward consistency matters.
     moe_capacity: float = 1.25
+    # "learned" absolute position table, or "rope" rotary q/k (relative;
+    # the long-context-friendly choice — no table capped at max_len)
+    pos_emb: str = "learned"
     layer_names = ["logits", "pool", "hidden", "embed"]
     input_dtype = jnp.int32  # token ids (FlaxBundle auto-init dummy dtype)
 
@@ -288,19 +320,27 @@ class TransformerLM(nn.Module):
             attn = lambda q, k, v: fused_attention(q, k, v, True)
         else:
             attn = lambda q, k, v: full_attention(q, k, v, causal=True)
+        if self.pos_emb not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_emb must be 'learned' or 'rope', got "
+                f"{self.pos_emb!r} — anything else would silently build a "
+                "position-blind model")
         taps: Dict[str, jnp.ndarray] = {}
         b, s = tokens.shape
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
                      name="tok_embed")(tokens)
-        pos = nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
-                       name="pos_embed")(jnp.arange(s))
-        x = x + pos[None]
+        if self.pos_emb == "learned":
+            pos = nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
+                           name="pos_embed")(jnp.arange(s))
+            x = x + pos[None]
         taps["embed"] = x
+        use_rope = self.pos_emb == "rope"
         for i in range(self.num_layers):
             x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,
                        dense_cls=self._dense_cls,
                        num_experts=self.moe_experts,
-                       moe_capacity=self.moe_capacity, name=f"block{i}")(x)
+                       moe_capacity=self.moe_capacity, rope=use_rope,
+                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         taps["hidden"] = x
         taps["pool"] = jnp.mean(x, axis=1).astype(jnp.float32)
@@ -321,18 +361,20 @@ class TransformerLM(nn.Module):
         drives this under lax.scan)."""
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
                      name="tok_embed")(token)
-        pe = nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
-                      name="pos_embed")
-        if jnp.ndim(pos) == 1:            # slot mode: per-row positions
-            x = x + pe(pos)[:, None]
-        else:
-            x = x + pe(jnp.arange(token.shape[1]) + pos)[None]
+        if self.pos_emb == "learned":
+            pe = nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
+                          name="pos_embed")
+            if jnp.ndim(pos) == 1:        # slot mode: per-row positions
+                x = x + pe(pos)[:, None]
+            else:
+                x = x + pe(jnp.arange(token.shape[1]) + pos)[None]
         new_cache = []
         for i in range(self.num_layers):
             x, layer_cache = _Block(
                 self.num_heads, self.mlp_ratio, self.dtype, attn_fn=None,
                 dense_cls=self._dense_cls, num_experts=self.moe_experts,
                 moe_capacity=self.moe_capacity,
+                rope=self.pos_emb == "rope",
                 name=f"block{i}")(x, cache=cache[i], pos=pos)
             new_cache.append(layer_cache)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -345,11 +387,11 @@ class TransformerLM(nn.Module):
 def transformer_lm(vocab_size=1024, embed_dim=128, num_layers=2, num_heads=4,
                    max_len=2048, dtype=jnp.bfloat16, attn_fn=None,
                    quant=False, moe_experts=0, moe_capacity=1.25,
-                   num_classes=None):
+                   pos_emb="learned", num_classes=None):
     """Builder (zoo registry).  `num_classes` is accepted and ignored so the
     generic builder call sites (get_builder(name)(num_classes=...)) work."""
     return TransformerLM(vocab_size=vocab_size, embed_dim=embed_dim,
                          num_layers=num_layers, num_heads=num_heads,
                          max_len=max_len, dtype=dtype, attn_fn=attn_fn,
                          quant=quant, moe_experts=moe_experts,
-                         moe_capacity=moe_capacity)
+                         moe_capacity=moe_capacity, pos_emb=pos_emb)

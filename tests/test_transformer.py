@@ -213,3 +213,15 @@ def test_moe_rows_are_independent_of_co_tenants():
     batched, _ = model.apply(variables, jnp.concatenate([row, other]))
     np.testing.assert_allclose(np.asarray(batched[0]), np.asarray(solo[0]),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_pos_emb_typo_is_rejected():
+    import pytest
+
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    model = transformer_lm(vocab_size=16, embed_dim=16, num_layers=1,
+                           num_heads=2, max_len=8, pos_emb="rotary")
+    with pytest.raises(ValueError, match="position-blind"):
+        model.init({"params": jax.random.PRNGKey(0)},
+                   jnp.zeros((1, 4), jnp.int32), train=False)
