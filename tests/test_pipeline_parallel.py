@@ -94,3 +94,38 @@ def test_pipeline_rejects_stage_count_mismatch():
         with MeshContext(mesh):
             pipeline_apply(_mlp_stage, stack_stage_params(per_stage),
                            x, mesh)
+
+
+def test_pipeline_runs_real_transformer_blocks():
+    # pp over the actual model: 4 stacked transformer blocks through the
+    # pipe == the same blocks applied sequentially (the embed/head stay
+    # outside, as in a real pp deployment)
+    import jax
+
+    from mmlspark_tpu.models.transformer import _Block
+    from mmlspark_tpu.parallel.ring_attention import full_attention
+
+    n_stages, m, mb, s, e = 4, 4, 2, 6, 16
+    mesh = make_mesh(data=2, model=n_stages)
+    attn = lambda q, k, v: full_attention(q, k, v, causal=True)
+    block = _Block(num_heads=2, mlp_ratio=2, dtype=jnp.float32,
+                   attn_fn=attn)
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.normal(size=(m, mb, s, e)), jnp.float32)
+    per_stage = [
+        block.init({"params": jax.random.PRNGKey(i)},
+                   jnp.zeros((mb, s, e), jnp.float32))["params"]
+        for i in range(n_stages)]
+
+    def stage_fn(params, xb):
+        return block.apply({"params": params}, xb)
+
+    with MeshContext(mesh):
+        got = pipeline_apply(stage_fn, stack_stage_params(per_stage),
+                             x0, mesh)
+    want = x0
+    for p in per_stage:
+        want = jax.vmap(lambda xb, _p=p: block.apply({"params": _p}, xb))(
+            want)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
