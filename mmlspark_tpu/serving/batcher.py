@@ -69,18 +69,33 @@ class ContinuousBatcher:
     """
 
     def __init__(self, model, variables, max_slots: int = 8,
-                 idle_sleep_s: float = 0.001):
+                 idle_sleep_s: float = 0.001,
+                 kv_cache_dtype: str = None):
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(f"kv_cache_dtype must be None or 'int8', "
+                             f"got {kv_cache_dtype!r}")
         self.model = model
         self.variables = {c: v for c, v in variables.items()
                           if c != "kvcache"}
         self.max_slots = int(max_slots)
         self.idle_sleep_s = float(idle_sleep_s)
+        self.kv_cache_dtype = kv_cache_dtype
         s, L = self.max_slots, model.max_len
         h, d = model.num_heads, model.embed_dim // model.num_heads
         dt = jnp.float32 if model.dtype == jnp.float32 else model.dtype
-        self._cache = tuple(
-            (jnp.zeros((s, L, h, d), dt), jnp.zeros((s, L, h, d), dt))
-            for _ in range(model.num_layers))
+        if kv_cache_dtype == "int8":
+            # 4x the co-tenant density per HBM byte: int8 rows + f32
+            # per-(pos, head) scales (ops/quant.quantize_kv_row)
+            self._cache = tuple(
+                (jnp.zeros((s, L, h, d), jnp.int8),
+                 jnp.zeros((s, L, h), jnp.float32),
+                 jnp.zeros((s, L, h, d), jnp.int8),
+                 jnp.zeros((s, L, h), jnp.float32))
+                for _ in range(model.num_layers))
+        else:
+            self._cache = tuple(
+                (jnp.zeros((s, L, h, d), dt), jnp.zeros((s, L, h, d), dt))
+                for _ in range(model.num_layers))
         self._pos = np.zeros(s, np.int32)
         self._tok = np.zeros(s, np.int32)
         self._live: List[Optional[_Request]] = [None] * s
@@ -147,7 +162,8 @@ class ContinuousBatcher:
         from ..models.generation import _prefill_cache
 
         logits, cache = _prefill_cache(self.model, self.variables,
-                                       jnp.asarray(req.prompt[None]))
+                                       jnp.asarray(req.prompt[None]),
+                                       self.kv_cache_dtype)
         self._cache = self._load(self._cache, cache, slot)
         first = int(jnp.argmax(logits[0, -1]))
         self._live[slot] = req

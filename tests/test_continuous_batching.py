@@ -143,3 +143,25 @@ def test_http_stream_reply_composition(lm):
         batcher.stop()
     for p, got in zip(prompts, results):
         assert got == _reference(model, variables, p, 5), (p, got)
+
+
+def test_int8_cache_slots_match_generate_int8(lm):
+    # int8 slot decode quantizes each written row exactly like generate's
+    # scalar int8 path — outputs match bit for bit, at 4x slot density
+    model, variables = lm
+    prompts = [[3, 1, 4], [1, 5, 9, 2], [6, 5]]
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                kv_cache_dtype="int8").start()
+    try:
+        streams = [batcher.submit(p, max_new_tokens=6) for p in prompts]
+        got = [s.tokens() for s in streams]
+    finally:
+        batcher.stop()
+    for p, toks in zip(prompts, got):
+        want = generate(model, variables, jnp.asarray(p)[None],
+                        max_new_tokens=6, kv_cache_dtype="int8")
+        assert toks == np.asarray(want)[0, len(p):].tolist(), (p, toks)
+    import pytest
+
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        ContinuousBatcher(model, variables, kv_cache_dtype="int4")
