@@ -165,3 +165,36 @@ def test_int8_cache_slots_match_generate_int8(lm):
 
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         ContinuousBatcher(model, variables, kv_cache_dtype="int4")
+
+
+def test_randomized_staggered_soak(lm):
+    # 12 requests, random lengths/budgets, submitted from threads at
+    # random times into 3 slots — every stream must still be exactly
+    # generate()'s output (seeded: deterministic)
+    import threading
+    import time
+
+    model, variables = lm
+    rng = np.random.default_rng(42)
+    jobs = [(rng.integers(0, 64, size=rng.integers(1, 9)).tolist(),
+             int(rng.integers(2, 8))) for _ in range(12)]
+    delays = rng.integers(0, 20, size=len(jobs))  # pre-drawn: Generator
+    batcher = ContinuousBatcher(model, variables, max_slots=3).start()
+    results = [None] * len(jobs)
+
+    def submit(i):
+        time.sleep(float(delays[i]) / 1000.0)
+        p, n = jobs[i]
+        results[i] = batcher.submit(p, max_new_tokens=n).tokens()
+
+    try:
+        threads = [threading.Thread(target=submit, args=(i,), daemon=True)
+                   for i in range(len(jobs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        batcher.stop()
+    for (p, n), toks in zip(jobs, results):
+        assert toks == _reference(model, variables, p, n), (p, n, toks)
