@@ -53,7 +53,8 @@ def _prefill_cache(model: TransformerLM, variables, prompt: jnp.ndarray,
     (ops/quant.quantize_kv_row; unwritten positions stay (0 * 0-scale)=0
     and are masked out of the softmax by the <= pos validity check)."""
     b, s_p = prompt.shape
-    h, d = model.num_heads, model.embed_dim // model.num_heads
+    h = model.kv_heads          # the cache stores the SHARED (GQA) heads
+    d = model.embed_dim // model.num_heads
     # drop any stale 'kvcache' collection captured at init time — sow
     # would try to append to it at the init shapes otherwise
     variables = {c: v for c, v in variables.items() if c != "kvcache"}

@@ -77,6 +77,18 @@ def _rope(x, positions, base: float = 10000.0):
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
+def _gqa_expand(kv, num_heads: int):
+    """[..., Hkv, D] or [..., Hkv] K/V (or scales) -> repeated to
+    num_heads along the head axis (no-op for MHA).  The cache STORES Hkv
+    heads — this expansion happens at attention-read time, where XLA can
+    fold the broadcast into the einsum's gather."""
+    axis = kv.ndim - 2 if kv.ndim >= 4 else kv.ndim - 1
+    reps = num_heads // kv.shape[axis]
+    if reps == 1:
+        return kv
+    return jnp.repeat(kv, reps, axis=axis)
+
+
 def _single_tpu() -> bool:
     """Default-attention dispatch predicate (separable so tests can force
     the Pallas branch on the CPU backend via interpret mode)."""
@@ -145,6 +157,11 @@ class _Block(nn.Module):
     mlp_ratio: int
     dtype: Any
     attn_fn: Callable
+    # grouped-query attention: kv_heads < num_heads shares each K/V head
+    # across num_heads//kv_heads query heads — the KV cache (the decode
+    # HBM bottleneck) shrinks by the same factor.  None = MHA; the fused
+    # qkv projection (and its param pytree) is kept in that case.
+    kv_heads: Optional[int] = None
     # injection point for quantized inference (ops/quant.QuantDense): same
     # param pytree as nn.Dense, so trained weights serve either class
     dense_cls: Any = nn.Dense
@@ -158,7 +175,8 @@ class _Block(nn.Module):
     def __call__(self, x, cache=None, pos=None):
         """cache=None: full causal attention over x (train/score path).
 
-        cache=(k_cache, v_cache) [B, max_len, H, D] with scalar `pos`:
+        cache=(k_cache, v_cache) [B, max_len, Hkv, D] (Hkv = kv_heads
+        or H — GQA caches store the SHARED heads) with scalar `pos`:
         block decode — x is [B, s, E] holding tokens at positions
         pos..pos+s-1 (s=1 is plain autoregressive decode); their K/V is
         written at `pos` (lax.dynamic_update_slice keeps shapes static)
@@ -166,18 +184,27 @@ class _Block(nn.Module):
         (out, cache).
 
         cache=(kq, ks, vq, vs): int8-quantized variant — kq/vq are int8
-        [B, max_len, H, D] with per-row-per-head f32 scales ks/vs
-        [B, max_len, H].  The cache read is 1/4 the HBM bytes of f32 (1/2
+        [B, max_len, Hkv, D] with per-row-per-head f32 scales ks/vs
+        [B, max_len, Hkv].  The cache read is 1/4 the HBM bytes of f32 (1/2
         of bf16) and long-context decode is cache-bandwidth-bound; the
         dequant multiply fuses into the attention matmul's read.
         """
         b, s, e = x.shape
         h = self.num_heads
         d = e // h
+        hkv = self.kv_heads or h
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        qkv = self.dense_cls(3 * e, use_bias=False, dtype=self.dtype,
-                             name="qkv")(y)
-        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
+        if hkv == h:
+            qkv = self.dense_cls(3 * e, use_bias=False, dtype=self.dtype,
+                                 name="qkv")(y)
+            q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
+        else:
+            q = self.dense_cls(e, use_bias=False, dtype=self.dtype,
+                               name="q")(y).reshape(b, s, h, d)
+            kv = self.dense_cls(2 * hkv * d, use_bias=False,
+                                dtype=self.dtype,
+                                name="kv")(y).reshape(b, s, 2 * hkv, d)
+            k, v = jnp.split(kv, 2, axis=2)
         if self.rope:
             if cache is None:
                 rp = jnp.arange(s)
@@ -195,7 +222,9 @@ class _Block(nn.Module):
             # q/k/v stay at model dtype so the attention matmuls hit the
             # MXU at full bf16 rate; the attention fns accumulate in f32
             # via preferred_element_type with f32 softmax statistics
-            a = self.attn_fn(q, k, v)
+            # (GQA: k/v repeat up to H here — the attn_fn contract wants
+            # matching heads; the CACHE below stays at hkv)
+            a = self.attn_fn(q, _gqa_expand(k, h), _gqa_expand(v, h))
         elif pos is not None and jnp.ndim(pos) == 1:
             # SLOT decode (continuous batching): x is [B, 1, E], pos [B] —
             # every slot sits at its OWN position (requests admitted at
@@ -220,8 +249,10 @@ class _Block(nn.Module):
                 vq = vq.at[rows_b, pos].set(vnew[:, 0])
                 vs = vs.at[rows_b, pos].set(vsc[:, 0])
                 cache = (kq, ks, vq, vs)
-                a = _cache_attention(q, kq, vq, pos[:, None], d,
-                                     k_scale=ks, v_scale=vs)
+                a = _cache_attention(q, _gqa_expand(kq, h),
+                                     _gqa_expand(vq, h), pos[:, None], d,
+                                     k_scale=_gqa_expand(ks, h),
+                                     v_scale=_gqa_expand(vs, h))
             else:
                 k_cache, v_cache = cache
                 k_cache = k_cache.at[rows_b, pos].set(
@@ -229,7 +260,9 @@ class _Block(nn.Module):
                 v_cache = v_cache.at[rows_b, pos].set(
                     v[:, 0].astype(v_cache.dtype))
                 cache = (k_cache, v_cache)
-                a = _cache_attention(q, k_cache, v_cache, pos[:, None], d)
+                a = _cache_attention(q, _gqa_expand(k_cache, h),
+                                     _gqa_expand(v_cache, h),
+                                     pos[:, None], d)
         elif len(cache) == 4:
             from ..ops.quant import quantize_kv_row
 
@@ -241,8 +274,10 @@ class _Block(nn.Module):
             vq = jax.lax.dynamic_update_slice(vq, vnew, (0, pos, 0, 0))
             vs = jax.lax.dynamic_update_slice(vs, vsc, (0, pos, 0))
             cache = (kq, ks, vq, vs)
-            a = _cache_attention(q, kq, vq, (pos + jnp.arange(s))[None], d,
-                                 k_scale=ks, v_scale=vs)
+            a = _cache_attention(q, _gqa_expand(kq, h), _gqa_expand(vq, h),
+                                 (pos + jnp.arange(s))[None], d,
+                                 k_scale=_gqa_expand(ks, h),
+                                 v_scale=_gqa_expand(vs, h))
         else:
             k_cache, v_cache = cache
             k_cache = jax.lax.dynamic_update_slice(
@@ -252,7 +287,8 @@ class _Block(nn.Module):
             cache = (k_cache, v_cache)
             # s queries over the whole (static-length) cache, each masked
             # to its own position: an [s, max_len] matmul per head
-            a = _cache_attention(q, k_cache, v_cache,
+            a = _cache_attention(q, _gqa_expand(k_cache, h),
+                                 _gqa_expand(v_cache, h),
                                  (pos + jnp.arange(s))[None], d)
         a = a.astype(self.dtype).reshape(b, s, e)
         x = x + self.dense_cls(e, use_bias=False, dtype=self.dtype,
@@ -307,7 +343,17 @@ class TransformerLM(nn.Module):
     # "learned" absolute position table, or "rope" rotary q/k (relative;
     # the long-context-friendly choice — no table capped at max_len)
     pos_emb: str = "learned"
+    # grouped-query attention: None = MHA; otherwise the number of shared
+    # K/V heads (must divide num_heads) — the KV cache shrinks by
+    # num_heads/num_kv_heads
+    num_kv_heads: Optional[int] = None
     layer_names = ["logits", "pool", "hidden", "embed"]
+
+    @property
+    def kv_heads(self) -> int:
+        """K/V head count — the KV-cache head dimension every cache
+        allocator (generation, batcher) must use."""
+        return self.num_kv_heads or self.num_heads
     input_dtype = jnp.int32  # token ids (FlaxBundle auto-init dummy dtype)
 
     @property
@@ -338,6 +384,12 @@ class TransformerLM(nn.Module):
                 f"pos_emb must be 'learned' or 'rope', got "
                 f"{self.pos_emb!r} — anything else would silently build a "
                 "position-blind model")
+        if self.num_kv_heads is not None and (
+                self.num_kv_heads < 1
+                or self.num_heads % self.num_kv_heads != 0):
+            raise ValueError(
+                f"num_kv_heads={self.num_kv_heads} must divide "
+                f"num_heads={self.num_heads}")
         taps: Dict[str, jnp.ndarray] = {}
         b, s = tokens.shape
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
@@ -353,6 +405,7 @@ class TransformerLM(nn.Module):
                        dense_cls=self._dense_cls,
                        num_experts=self.moe_experts,
                        moe_capacity=self.moe_capacity, rope=use_rope,
+                       kv_heads=self.num_kv_heads,
                        name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         taps["hidden"] = x
@@ -388,6 +441,7 @@ class TransformerLM(nn.Module):
                 dense_cls=self._dense_cls, num_experts=self.moe_experts,
                 moe_capacity=self.moe_capacity,
                 rope=self.pos_emb == "rope",
+                kv_heads=self.num_kv_heads,
                 name=f"block{i}")(x, cache=cache[i], pos=pos)
             new_cache.append(layer_cache)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -400,11 +454,12 @@ class TransformerLM(nn.Module):
 def transformer_lm(vocab_size=1024, embed_dim=128, num_layers=2, num_heads=4,
                    max_len=2048, dtype=jnp.bfloat16, attn_fn=None,
                    quant=False, moe_experts=0, moe_capacity=1.25,
-                   pos_emb="learned", num_classes=None):
+                   pos_emb="learned", num_kv_heads=None, num_classes=None):
     """Builder (zoo registry).  `num_classes` is accepted and ignored so the
     generic builder call sites (get_builder(name)(num_classes=...)) work."""
     return TransformerLM(vocab_size=vocab_size, embed_dim=embed_dim,
                          num_layers=num_layers, num_heads=num_heads,
                          max_len=max_len, dtype=dtype, attn_fn=attn_fn,
                          quant=quant, moe_experts=moe_experts,
-                         moe_capacity=moe_capacity, pos_emb=pos_emb)
+                         moe_capacity=moe_capacity, pos_emb=pos_emb,
+                         num_kv_heads=num_kv_heads)

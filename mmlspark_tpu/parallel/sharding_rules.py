@@ -26,7 +26,9 @@ def lm_tensor_parallel_rules(path, arr, axis: str = "model"):
     head shard output features, proj/mlp_out shard input features (the
     megatron pairing — one all-reduce per block, none inside the MLP)."""
     names = path_names(path)
-    if arr.ndim == 2 and any(n in names for n in ("qkv", "mlp_in", "head")):
+    # 'qkv' is the fused MHA projection; GQA splits it into 'q' + 'kv'
+    if arr.ndim == 2 and any(n in names for n in
+                             ("qkv", "q", "kv", "mlp_in", "head")):
         return P(None, axis)
     if arr.ndim == 2 and any(n in names for n in ("proj", "mlp_out")):
         return P(axis, None)

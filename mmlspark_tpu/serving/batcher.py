@@ -81,7 +81,8 @@ class ContinuousBatcher:
         self.idle_sleep_s = float(idle_sleep_s)
         self.kv_cache_dtype = kv_cache_dtype
         s, L = self.max_slots, model.max_len
-        h, d = model.num_heads, model.embed_dim // model.num_heads
+        h = model.kv_heads
+        d = model.embed_dim // model.num_heads
         dt = jnp.float32 if model.dtype == jnp.float32 else model.dtype
         if kv_cache_dtype == "int8":
             # 4x the co-tenant density per HBM byte: int8 rows + f32

@@ -468,3 +468,62 @@ def test_rope_block_decode_at_offset_matches_forward():
     np.testing.assert_allclose(np.asarray(lg_block[0, -1]),
                                np.asarray(lg_full[0, -1]),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_decode_matches_full_forward():
+    # grouped-query attention (2 KV heads under 4 query heads): KV cache
+    # shrinks 2x, and every decode path still matches the full forward —
+    # greedy oracle + speculative + int8 cache + rope composition
+    from mmlspark_tpu.models.generation import speculative_generate
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    model = transformer_lm(vocab_size=48, embed_dim=32, num_layers=2,
+                           num_heads=4, max_len=40, dtype=jnp.float32,
+                           num_kv_heads=2, pos_emb="rope")
+    assert model.kv_heads == 2
+    prompt = jnp.asarray([[7, 3, 11]], jnp.int32)
+    variables = {c: v for c, v in model.init(
+        {"params": jax.random.PRNGKey(3)}, prompt).items()
+        if c != "kvcache"}
+    # separate q/kv projections replace the fused qkv
+    blk = variables["params"]["block0"]
+    assert "qkv" not in blk and blk["kv"]["kernel"].shape == (32, 2 * 2 * 8)
+    out = generate(model, variables, prompt, max_new_tokens=7)
+    toks = prompt
+    for _ in range(7):
+        logits, _ = model.apply(variables, toks, train=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+    spec = speculative_generate(model, variables, model, variables,
+                                prompt, max_new_tokens=7, gamma=3)
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(out))
+    q8 = generate(model, variables, prompt, max_new_tokens=7,
+                  kv_cache_dtype="int8")
+    # int8 rounding noise can flip late greedy tokens on random weights;
+    # the prompt echo + first tokens must agree
+    np.testing.assert_array_equal(np.asarray(q8[:, :5]),
+                                  np.asarray(out[:, :5]))
+
+
+def test_gqa_continuous_batching_exact():
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.serving.batcher import ContinuousBatcher
+
+    model = transformer_lm(vocab_size=32, embed_dim=32, num_layers=1,
+                           num_heads=4, max_len=24, dtype=jnp.float32,
+                           num_kv_heads=1)   # MQA: one shared KV head
+    variables = {c: v for c, v in model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 4), jnp.int32)).items() if c != "kvcache"}
+    prompts = [[3, 1, 4], [9, 8]]
+    batcher = ContinuousBatcher(model, variables, max_slots=2).start()
+    try:
+        got = [batcher.submit(p, max_new_tokens=5).tokens()
+               for p in prompts]
+    finally:
+        batcher.stop()
+    for p, toks in zip(prompts, got):
+        want = generate(model, variables, jnp.asarray(p)[None],
+                        max_new_tokens=5)
+        assert toks == np.asarray(want)[0, len(p):].tolist()

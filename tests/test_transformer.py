@@ -225,3 +225,16 @@ def test_pos_emb_typo_is_rejected():
     with pytest.raises(ValueError, match="position-blind"):
         model.init({"params": jax.random.PRNGKey(0)},
                    jnp.zeros((1, 4), jnp.int32), train=False)
+
+
+def test_bad_kv_heads_rejected():
+    import pytest
+
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    for bad in (3, 8, 0):
+        model = transformer_lm(vocab_size=16, embed_dim=16, num_layers=1,
+                               num_heads=4, max_len=8, num_kv_heads=bad)
+        with pytest.raises(ValueError, match="must divide"):
+            model.init({"params": jax.random.PRNGKey(0)},
+                       jnp.zeros((1, 4), jnp.int32), train=False)
