@@ -190,9 +190,13 @@ def decode_child() -> int:
         0, cfg["vocab_size"], size=(1, 16)), jnp.int32)
     new_tokens = cfg["max_len"] - 32
     results = {}
-    for tag, quant, kv in (("f32", False, None), ("int8", True, None),
-                           ("int8_kv8", True, "int8")):
-        model = transformer_lm(dtype=jnp.float32, quant=quant, **cfg)
+    for tag, quant, kv, kvh in (("f32", False, None, None),
+                                ("int8", True, None, None),
+                                ("int8_kv8", True, "int8", None),
+                                ("gqa4", False, None, "quarter")):
+        kv_heads = max(1, cfg["num_heads"] // 4) if kvh else None
+        model = transformer_lm(dtype=jnp.float32, quant=quant,
+                               num_kv_heads=kv_heads, **cfg)
         variables = {c: v for c, v in jax.jit(
             lambda r, t: model.init(r, t))(
                 jax.random.PRNGKey(0), prompt).items() if c != "kvcache"}
