@@ -130,7 +130,8 @@ class _MoEMLP(nn.Module):
         onehot = jax.nn.one_hot(expert, nx)                     # [B, S, X]
         # position of each token in its row's expert queue; beyond-cap
         # tokens drop
-        pos = jnp.sum(jnp.cumsum(onehot, axis=1) * onehot, axis=-1) - 1
+        pos = (jnp.sum(jnp.cumsum(onehot, axis=1) * onehot, axis=-1)
+               .astype(jnp.int32) - 1)
         keep = (pos < cap) & (pos >= 0)
         disp = (onehot[..., None] * jax.nn.one_hot(pos, cap)[:, :, None, :]
                 * keep[..., None, None])                     # [B, S, X, C]
