@@ -238,3 +238,50 @@ def test_bad_kv_heads_rejected():
         with pytest.raises(ValueError, match="must divide"):
             model.init({"params": jax.random.PRNGKey(0)},
                        jnp.zeros((1, 4), jnp.int32), train=False)
+
+
+def test_modern_stack_composition():
+    # every feature at once: rope positions + grouped-query attention +
+    # switch-MoE MLPs (drop-free capacity) + prequantized int8 weights,
+    # trained a step, then KV-cached greedy decode vs the full-forward
+    # oracle AND speculative self-drafting — compositions are where the
+    # bugs hide, so the whole stack gets one exactness gate
+    import optax
+
+    from mmlspark_tpu.models.generation import (generate,
+                                                speculative_generate)
+    from mmlspark_tpu.models.training import make_lm_train_epoch
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.ops.quant import prequantize
+
+    cfg = dict(vocab_size=48, embed_dim=32, num_layers=2, num_heads=4,
+               max_len=40, dtype=jnp.float32, pos_emb="rope",
+               num_kv_heads=2, moe_experts=2, moe_capacity=8.0)
+    model = transformer_lm(**cfg)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 48, size=(2, 8, 12)), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, toks[0],
+                        train=False)["params"]
+    opt = optax.adam(1e-2)
+    epoch = make_lm_train_epoch(model, opt, donate=False)
+    params, _, losses = epoch(params, opt.init(params), toks)
+    assert np.all(np.isfinite(np.asarray(losses)))
+
+    variables = {"params": params}
+    prompt = jnp.asarray([[5, 9, 2]], jnp.int32)
+    want = generate(model, variables, prompt, max_new_tokens=6)
+    naive = prompt
+    for _ in range(6):
+        lg, _ = model.apply(variables, naive, train=False)
+        naive = jnp.concatenate(
+            [naive, jnp.argmax(lg[:, -1], -1).astype(jnp.int32)[:, None]],
+            axis=1)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(naive))
+
+    # int8 weights on top: the quantized variant drafts for the full-
+    # precision target, output still exactly target-greedy
+    qmodel = transformer_lm(**{**cfg, "quant": True})
+    qvars = prequantize(qmodel, variables, prompt)
+    spec = speculative_generate(model, variables, qmodel, qvars,
+                                prompt, max_new_tokens=6, gamma=3)
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(want))
