@@ -198,3 +198,28 @@ def test_randomized_staggered_soak(lm):
         batcher.stop()
     for (p, n), toks in zip(jobs, results):
         assert toks == _reference(model, variables, p, n), (p, n, toks)
+
+
+def test_modern_stack_batcher(lm):
+    # rope + MQA + int8 slots through the batcher: streams must equal
+    # generate's int8 decode for the same modern-stack model
+    from mmlspark_tpu.models.transformer import transformer_lm
+
+    model = transformer_lm(vocab_size=32, embed_dim=32, num_layers=1,
+                           num_heads=4, max_len=24, dtype=jnp.float32,
+                           pos_emb="rope", num_kv_heads=1)
+    variables = {c: v for c, v in model.init(
+        {"params": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 4), jnp.int32)).items() if c != "kvcache"}
+    prompts = [[3, 1, 4], [9, 8]]
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                kv_cache_dtype="int8").start()
+    try:
+        got = [batcher.submit(p, max_new_tokens=5).tokens()
+               for p in prompts]
+    finally:
+        batcher.stop()
+    for p, toks in zip(prompts, got):
+        want = generate(model, variables, jnp.asarray(p)[None],
+                        max_new_tokens=5, kv_cache_dtype="int8")
+        assert toks == np.asarray(want)[0, len(p):].tolist(), (p, toks)
