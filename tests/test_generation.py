@@ -527,3 +527,31 @@ def test_gqa_continuous_batching_exact():
         want = generate(model, variables, jnp.asarray(p)[None],
                         max_new_tokens=5)
         assert toks == np.asarray(want)[0, len(p):].tolist()
+
+
+def test_tensor_parallel_gqa_generate():
+    # the GQA projections ('q'/'kv') must be covered by the tp rules:
+    # sharded decode == unsharded, token for token
+    from mmlspark_tpu.models.training import shard_params
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.parallel.mesh import MeshContext, make_mesh
+    from mmlspark_tpu.parallel.sharding_rules import lm_tensor_parallel_rules
+
+    model = transformer_lm(vocab_size=64, embed_dim=32, num_layers=2,
+                           num_heads=4, max_len=32, dtype=jnp.float32,
+                           num_kv_heads=2)
+    prompt = jnp.asarray([[2, 7, 1]], jnp.int32)
+    variables = {c: v for c, v in model.init(
+        {"params": jax.random.PRNGKey(4)}, prompt).items()
+        if c != "kvcache"}
+    base = generate(model, variables, prompt, max_new_tokens=6)
+    mesh = make_mesh(data=4, model=2)
+    with MeshContext(mesh):
+        sharded = dict(variables)
+        sharded["params"] = shard_params(variables["params"], mesh,
+                                         lm_tensor_parallel_rules)
+        # the q/kv kernels really are sharded over 'model'
+        spec = sharded["params"]["block0"]["kv"]["kernel"].sharding.spec
+        assert spec == (None, "model"), spec
+        out = jax.jit(lambda v, p: generate(model, v, p, 6))(sharded, prompt)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
