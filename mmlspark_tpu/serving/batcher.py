@@ -102,6 +102,7 @@ class ContinuousBatcher:
         self._live: List[Optional[_Request]] = [None] * s
         self._pending: "Queue[_Request]" = Queue()
         self._running = threading.Event()
+        self._stopped = False
         self._thread: Optional[threading.Thread] = None
         self._step = jax.jit(
             lambda v, t, c, p: self.model.apply(
@@ -123,6 +124,10 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {len(prompt)} + {max_new_tokens} exceeds "
                 f"max_len {self.model.max_len}")
+        if self._stopped:
+            # a late submit racing stop() would otherwise wait forever on
+            # a stream nobody will ever close
+            raise RuntimeError("ContinuousBatcher is stopped")
         req = _Request(prompt, max_new_tokens, eos_id)
         self._pending.put(req)
         return req.stream
@@ -146,6 +151,7 @@ class ContinuousBatcher:
         return self
 
     def stop(self):
+        self._stopped = True
         self._running.clear()
         if self._thread is not None:
             self._thread.join(timeout=10)

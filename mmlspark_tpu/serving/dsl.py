@@ -43,8 +43,9 @@ __all__ = ["read_stream", "StreamReader", "StreamingQuery",
 class StreamingQuery:
     """A started serving pipeline (the StreamingQuery analog)."""
 
-    def __init__(self, servers: List[ServingServer]):
+    def __init__(self, servers: List[ServingServer], on_stop=()):
         self._servers = servers
+        self._on_stop = list(on_stop)
 
     @property
     def service_info(self) -> ServiceInfo:
@@ -68,6 +69,8 @@ class StreamingQuery:
     def stop(self) -> None:
         for s in self._servers:
             s.stop()
+        for fn in self._on_stop:
+            fn()
 
 
 class StreamReader:
@@ -89,6 +92,7 @@ class StreamReader:
         self._trigger_interval_ms = 20.0
         self._journal_path: Optional[str] = None
         self._stream_fn = None
+        self._gen_cfg = None
         self._stream_workers = 8
 
     # ---- sources (IOImplicits server/distributedServer/continuousServer)
@@ -145,6 +149,25 @@ class StreamReader:
         iterable of str/bytes` chunks, flushed to the client as produced —
         the token-by-token generation shape.  At-most-once delivery."""
         self._stream_fn = fn
+        self._gen_cfg = None   # the sinks are mutually exclusive
+        return self
+
+    def generate_stream(self, model, variables, tokenizer=None,
+                        max_new_tokens: int = 32, max_slots: int = 8,
+                        kv_cache_dtype=None) -> "StreamReader":
+        """The whole LM endpoint in one call: a ContinuousBatcher owns
+        the decode (concurrent clients share one slotted device step) and
+        stops with the query.  With a `tokenizer` (BPETokenizerModel),
+        requests post {"prompt": "<text>"} and stream decoded text
+        chunks; without one, {"prompt": [ids...]} streams token ids.
+        The batcher is built PER start() call, so a builder can start
+        several independent queries."""
+        self._gen_cfg = dict(model=model, variables=variables,
+                             tokenizer=tokenizer,
+                             max_new_tokens=int(max_new_tokens),
+                             max_slots=int(max_slots),
+                             kv_cache_dtype=kv_cache_dtype)
+        self._stream_fn = None
         return self
 
     def options(self, max_batch: Optional[int] = None,
@@ -170,16 +193,36 @@ class StreamReader:
 
     # ---- sink ----------------------------------------------------------
     def start(self) -> StreamingQuery:
-        if self._stream_fn is None and (
-                self._model is None or self._reply_col is None):
+        if (self._stream_fn is None and self._gen_cfg is None and (
+                self._model is None or self._reply_col is None)):
             raise ValueError("streaming query needs .transform(model) and "
-                             ".make_reply(col) — or .stream_reply(fn) — "
-                             "before start()")
+                             ".make_reply(col) — or .stream_reply(fn) / "
+                             ".generate_stream(...) — before start()")
+        batcher = None
+        stream_fn = self._stream_fn
+        if self._gen_cfg is not None:
+            from .batcher import ContinuousBatcher
+
+            cfg = self._gen_cfg
+            batcher = ContinuousBatcher(
+                cfg["model"], cfg["variables"], max_slots=cfg["max_slots"],
+                kv_cache_dtype=cfg["kv_cache_dtype"])
+
+            def stream_fn(row, _b=batcher, _c=cfg):
+                if _c["tokenizer"] is not None:
+                    yield from _b.stream_text(_c["tokenizer"],
+                                              str(row["prompt"]),
+                                              _c["max_new_tokens"])
+                else:
+                    for tok in _b.submit([int(t) for t in row["prompt"]],
+                                         _c["max_new_tokens"]):
+                        yield f"{tok} "
+
         servers = []
         for r in range(self._replicas):
             srv = ServingServer(
                 model=self._model, reply_col=self._reply_col,
-                stream_fn=self._stream_fn,
+                stream_fn=stream_fn,
                 stream_workers=self._stream_workers,
                 name=self._name if self._replicas == 1
                 else f"{self._name}-{r}",
@@ -196,7 +239,13 @@ class StreamReader:
                                  ServiceInfo(self._name, info.host,
                                              info.port, info.path))
             servers.append(srv)
-        return StreamingQuery(servers)
+        on_stop = []
+        if batcher is not None:
+            batcher.start()
+            on_stop.append(batcher.stop)
+        query = StreamingQuery(servers, on_stop=on_stop)
+        query._batcher = batcher   # observability (tests, diagnostics)
+        return query
 
 
 def read_stream() -> StreamReader:

@@ -223,3 +223,55 @@ def test_modern_stack_batcher(lm):
         want = generate(model, variables, jnp.asarray(p)[None],
                         max_new_tokens=5, kv_cache_dtype="int8")
         assert toks == np.asarray(want)[0, len(p):].tolist(), (p, toks)
+
+
+def test_generate_stream_one_call_endpoint(lm):
+    # the packaged LM endpoint: read_stream().generate_stream(...) owns
+    # the batcher (started with the query, stopped with it) and streams
+    # generate()-exact tokens to concurrent clients
+    import http.client
+    import json as _json
+    import threading
+
+    from mmlspark_tpu.serving import read_stream
+
+    model, variables = lm
+    query = (read_stream()
+             .continuous_server(name="gen1call", path="/lm")
+             .parse_request(schema=["prompt"])
+             .generate_stream(model, variables, max_new_tokens=5,
+                              max_slots=2)
+             .options(batch_timeout_ms=5.0)
+             .start())
+    prompts = [[3, 1, 4], [9, 8], [2, 2, 7, 5]]
+    results = [None] * len(prompts)
+
+    def client(i):
+        conn = http.client.HTTPConnection(query.service_info.host,
+                                          query.service_info.port,
+                                          timeout=30)
+        conn.request("POST", "/lm", body=_json.dumps(
+            {"prompt": prompts[i]}).encode())
+        results[i] = [int(t) for t in
+                      conn.getresponse().read().decode().split()]
+        conn.close()
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        query.stop()
+    for p, got in zip(prompts, results):
+        assert got == _reference(model, variables, p, 5), (p, got)
+    # stop() also stopped the BATCHER, not just the servers
+    assert not query.is_active()
+    assert not query._batcher._running.is_set()
+    assert not query._batcher._thread.is_alive()
+    import pytest
+
+    with pytest.raises(RuntimeError, match="stopped"):
+        query._batcher.submit([1, 2], max_new_tokens=2)
