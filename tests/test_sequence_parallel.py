@@ -220,3 +220,36 @@ def test_tagger_mismatched_lengths_raise():
     tags = np.empty(1, dtype=object); tags[0] = ["X"]
     with pytest.raises(ValueError, match="must align"):
         SequenceTagger().fit(Table({"tokens": toks, "tags": tags}))
+
+
+def test_rope_composes_with_ring_attention():
+    # RoPE rotations happen at GLOBAL positions inside the blocks (the
+    # model runs at global shapes; sharding lives inside the attn_fn), so
+    # a rope model under ring attention must equal the same weights under
+    # dense attention — the previously-unverified composition
+    from functools import partial
+
+    import jax
+
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.parallel.mesh import MeshContext, make_mesh
+
+    sp_mesh = make_mesh(data=1, seq=8)
+    dense = transformer_lm(vocab_size=32, embed_dim=16, num_layers=2,
+                           num_heads=2, max_len=64, dtype=jnp.float32,
+                           pos_emb="rope",
+                           attn_fn=lambda q, k, v: full_attention(
+                               q, k, v, causal=True))
+    ringm = transformer_lm(vocab_size=32, embed_dim=16, num_layers=2,
+                           num_heads=2, max_len=64, dtype=jnp.float32,
+                           pos_emb="rope",
+                           attn_fn=partial(ring_attention, mesh=sp_mesh,
+                                           causal=True))
+    toks = jnp.asarray(np.arange(32).reshape(1, 32) % 32, jnp.int32)
+    variables = {c: v for c, v in dense.init(
+        {"params": jax.random.PRNGKey(0)}, toks).items() if c != "kvcache"}
+    lg_dense, _ = dense.apply(variables, toks)
+    with MeshContext(sp_mesh):
+        lg_ring, _ = ringm.apply(variables, toks)
+    np.testing.assert_allclose(np.asarray(lg_ring), np.asarray(lg_dense),
+                               rtol=2e-4, atol=2e-4)
