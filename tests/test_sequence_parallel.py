@@ -253,13 +253,15 @@ def test_rope_composes_with_ring_attention():
         lg_ring, _ = ringm.apply(variables, toks)
     np.testing.assert_allclose(np.asarray(lg_ring), np.asarray(lg_dense),
                                rtol=2e-4, atol=2e-4)
-    # and the all-to-all variant (same global-shape argument)
+    # and the all-to-all variant (same global-shape argument; Ulysses
+    # needs heads % axis == 0, so it gets a 2-wide seq axis)
+    u_mesh = make_mesh(data=4, seq=2)
     ulm = transformer_lm(vocab_size=32, embed_dim=16, num_layers=2,
                          num_heads=2, max_len=64, dtype=jnp.float32,
                          pos_emb="rope",
-                         attn_fn=partial(ulysses_attention, mesh=sp_mesh,
+                         attn_fn=partial(ulysses_attention, mesh=u_mesh,
                                          causal=True))
-    with MeshContext(sp_mesh):
+    with MeshContext(u_mesh):
         lg_uly, _ = ulm.apply(variables, toks)
     np.testing.assert_allclose(np.asarray(lg_uly), np.asarray(lg_dense),
                                rtol=2e-4, atol=2e-4)
