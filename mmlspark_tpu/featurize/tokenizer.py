@@ -185,6 +185,17 @@ class BPETokenizerModel(Model):
             ids.append(EOS_ID)
         return np.asarray(ids, np.int32)
 
+    def is_word_end(self, tok_id: int) -> bool:
+        """True when this token COMPLETES a word (its string carries the
+        end-of-word marker).  Streaming emitters buffer ids until this
+        fires so subword splits never leak spaces mid-word.  Specials and
+        out-of-range ids are NOT word ends: decode() drops them, so
+        flushing on one would split the surrounding word — they ride in
+        the buffer until a real end-of-word (or stream end) arrives."""
+        if not 0 <= tok_id < len(self.vocab):
+            return False
+        return self.vocab[tok_id].endswith(_EOW)
+
     def decode(self, ids) -> str:
         """Ids back to text; specials (<pad>/<unk>/<eos>) drop out."""
         toks = [self.vocab[i] for i in np.asarray(ids).tolist()

@@ -125,8 +125,11 @@ def generate(model: TransformerLM, variables, prompt: jnp.ndarray,
     def sample(lg, key):
         if temperature == 0.0:
             return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        lg = _filter_logits(lg, top_k, top_p)
-        return jax.random.categorical(key, lg / temperature).astype(jnp.int32)
+        # temperature FIRST, then top-k/top-p on the tempered distribution
+        # (the conventional order: nucleus membership reflects the actual
+        # sampling distribution, not the T=1 one)
+        lg = _filter_logits(lg / temperature, top_k, top_p)
+        return jax.random.categorical(key, lg).astype(jnp.int32)
 
     # ---- decode: one scan over the new tokens ---------------------------
     def body(carry, _):

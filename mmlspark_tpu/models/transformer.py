@@ -173,7 +173,7 @@ class _Block(nn.Module):
     rope: bool = False
 
     @nn.compact
-    def __call__(self, x, cache=None, pos=None):
+    def __call__(self, x, cache=None, pos=None, page_table=None):
         """cache=None: full causal attention over x (train/score path).
 
         cache=(k_cache, v_cache) [B, max_len, Hkv, D] (Hkv = kv_heads
@@ -189,6 +189,15 @@ class _Block(nn.Module):
         [B, max_len, Hkv].  The cache read is 1/4 the HBM bytes of f32 (1/2
         of bf16) and long-context decode is cache-bandwidth-bound; the
         dequant multiply fuses into the attention matmul's read.
+
+        page_table [B, MP] int32 (slot decode only): the cache tuples are
+        PAGE POOLS [NP, page, Hkv, D] (+[NP, page, Hkv] scales for int8)
+        instead of per-slot rows — slot b's logical cache position p lives
+        at pool[page_table[b, p // page], p % page].  Physical page 0 is
+        the write-trash page: unallocated table entries point at it, so a
+        free slot's dead write can never corrupt a live slot's pages, and
+        gathered trash rows sit at logical positions > pos where the
+        validity mask already hides them.
         """
         b, s, e = x.shape
         h = self.num_heads
@@ -239,7 +248,54 @@ class _Block(nn.Module):
                     f"slot decode is single-token (got s={s}); block "
                     "decode needs a scalar pos")
             rows_b = jnp.arange(b)
-            if len(cache) == 4:
+            if page_table is not None:
+                # PAGED slot decode: write one row into the owning page,
+                # gather each slot's pages back into a logical [B, L, H, D]
+                # view for the shared masked attention.  Storage is
+                # pay-per-page (the continuous-batching density win); the
+                # gather is XLA's — a Mosaic page-table kernel can replace
+                # it without touching this contract.
+                page = cache[0].shape[1]
+                pg = page_table[rows_b, pos // page]          # [B]
+                off = pos % page
+                mp = page_table.shape[1]
+                if len(cache) == 4:
+                    from ..ops.quant import quantize_kv_row
+
+                    kq, ks, vq, vs = cache
+                    knew, ksc = quantize_kv_row(k)
+                    vnew, vsc = quantize_kv_row(v)
+                    kq = kq.at[pg, off].set(knew[:, 0])
+                    ks = ks.at[pg, off].set(ksc[:, 0])
+                    vq = vq.at[pg, off].set(vnew[:, 0])
+                    vs = vs.at[pg, off].set(vsc[:, 0])
+                    cache = (kq, ks, vq, vs)
+                    a = _cache_attention(
+                        q,
+                        _gqa_expand(kq[page_table].reshape(
+                            b, mp * page, hkv, d), h),
+                        _gqa_expand(vq[page_table].reshape(
+                            b, mp * page, hkv, d), h),
+                        pos[:, None], d,
+                        k_scale=_gqa_expand(ks[page_table].reshape(
+                            b, mp * page, hkv), h),
+                        v_scale=_gqa_expand(vs[page_table].reshape(
+                            b, mp * page, hkv), h))
+                else:
+                    k_pool, v_pool = cache
+                    k_pool = k_pool.at[pg, off].set(
+                        k[:, 0].astype(k_pool.dtype))
+                    v_pool = v_pool.at[pg, off].set(
+                        v[:, 0].astype(v_pool.dtype))
+                    cache = (k_pool, v_pool)
+                    a = _cache_attention(
+                        q,
+                        _gqa_expand(k_pool[page_table].reshape(
+                            b, mp * page, hkv, d), h),
+                        _gqa_expand(v_pool[page_table].reshape(
+                            b, mp * page, hkv, d), h),
+                        pos[:, None], d)
+            elif len(cache) == 4:
                 from ..ops.quant import quantize_kv_row
 
                 kq, ks, vq, vs = cache
@@ -418,14 +474,18 @@ class TransformerLM(nn.Module):
         return logits, taps
 
     @nn.compact
-    def decode_step(self, token, cache, pos):
+    def decode_step(self, token, cache, pos, page_table=None):
         """Block decode: token [B, s] int32 at positions pos..pos+s-1
         attends over the per-layer KV cache (written in place at `pos`);
         s=1 is the classic autoregressive step, s>1 serves speculative
         verification / chunked decode.  Returns (logits [B, s, V] f32,
         new_cache).  Parameter names/shapes are identical to __call__, so
         one set of trained weights serves both paths (models/generation.py
-        drives this under lax.scan)."""
+        drives this under lax.scan).
+
+        With `page_table` [B, MP] the per-layer cache tuples are shared
+        page POOLS (vLLM-style paged KV; see _Block.__call__) — the
+        serving batcher's pay-per-page slot mode."""
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
                      name="tok_embed")(token)
         if self.pos_emb == "learned":
@@ -443,7 +503,8 @@ class TransformerLM(nn.Module):
                 moe_capacity=self.moe_capacity,
                 rope=self.pos_emb == "rope",
                 kv_heads=self.num_kv_heads,
-                name=f"block{i}")(x, cache=cache[i], pos=pos)
+                name=f"block{i}")(x, cache=cache[i], pos=pos,
+                                  page_table=page_table)
             new_cache.append(layer_cache)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = self._dense_cls(self.vocab_size, use_bias=False,

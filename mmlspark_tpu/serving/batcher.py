@@ -7,7 +7,9 @@ owns a slot in a static [S, max_len, ...] KV cache; slots sit at their
 OWN positions (`decode_step` slot mode, models/transformer.py), so
 requests admit/finish independently — a new stream joins the running
 batch the tick after an old one leaves, no recompile (the vLLM-style
-continuous-batching shape, minus paging).
+continuous-batching shape).  `paged=True` swaps the per-slot cache for
+shared page pools + a page table (vLLM paged KV): HBM is pay-per-page,
+so co-tenant density stops being bounded by max_slots * max_len.
 
 Host loop per tick: admit pending prompts into free slots (one prefill
 forward each; its padded cache rows overwrite the slot), one batched
@@ -21,6 +23,7 @@ gives token-by-token HTTP with cross-request batching on the device.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from queue import Empty, Queue
 from typing import Iterator, List, Optional
 
@@ -70,7 +73,9 @@ class ContinuousBatcher:
 
     def __init__(self, model, variables, max_slots: int = 8,
                  idle_sleep_s: float = 0.001,
-                 kv_cache_dtype: str = None):
+                 kv_cache_dtype: str = None,
+                 paged: bool = False, page_size: int = 64,
+                 num_pages: Optional[int] = None):
         if kv_cache_dtype not in (None, "int8"):
             raise ValueError(f"kv_cache_dtype must be None or 'int8', "
                              f"got {kv_cache_dtype!r}")
@@ -80,39 +85,96 @@ class ContinuousBatcher:
         self.max_slots = int(max_slots)
         self.idle_sleep_s = float(idle_sleep_s)
         self.kv_cache_dtype = kv_cache_dtype
+        self.paged = bool(paged)
         s, L = self.max_slots, model.max_len
         h = model.kv_heads
         d = model.embed_dim // model.num_heads
         dt = jnp.float32 if model.dtype == jnp.float32 else model.dtype
+        if self.paged:
+            # vLLM-style paged KV: per-layer PAGE POOLS shared by every
+            # slot + a [S, MP] page table.  HBM cost is pay-per-page
+            # (Σ ceil(live_len_i / page) pages) instead of S * max_len —
+            # the stream-density lever past int8's 4x, and it composes
+            # with kv_cache_dtype="int8".  Admission reserves each
+            # request's WORST-CASE page count up front (counts only;
+            # allocation stays lazy), so a running stream can never hit
+            # pool exhaustion mid-decode.  Physical page 0 is the
+            # write-trash page: free slots' dead writes and unallocated
+            # table entries land there harmlessly (gathered trash rows
+            # sit at positions the <= pos validity mask already hides).
+            if L % int(page_size):
+                raise ValueError(
+                    f"page_size {page_size} must divide max_len {L}")
+            self.page_size = int(page_size)
+            self._mp = L // self.page_size          # max pages per slot
+            self._np = (int(num_pages) if num_pages is not None
+                        else s * self._mp + 1)      # default: dense parity
+            if self._np < 2:
+                raise ValueError("num_pages must be >= 2 (page 0 is trash)")
+            shape4 = (self._np, self.page_size, h, d)
+            shape3 = (self._np, self.page_size, h)
+            self._free: List[int] = list(range(1, self._np))
+            self._avail = len(self._free)           # unreserved budget
+            self._slot_pages: List[List[int]] = [[] for _ in range(s)]
+            self._slot_reserved = [0] * s
+            self._table = np.zeros((s, self._mp), np.int32)
+        else:
+            shape4, shape3 = (s, L, h, d), (s, L, h)
         if kv_cache_dtype == "int8":
             # 4x the co-tenant density per HBM byte: int8 rows + f32
             # per-(pos, head) scales (ops/quant.quantize_kv_row)
             self._cache = tuple(
-                (jnp.zeros((s, L, h, d), jnp.int8),
-                 jnp.zeros((s, L, h), jnp.float32),
-                 jnp.zeros((s, L, h, d), jnp.int8),
-                 jnp.zeros((s, L, h), jnp.float32))
+                (jnp.zeros(shape4, jnp.int8),
+                 jnp.zeros(shape3, jnp.float32),
+                 jnp.zeros(shape4, jnp.int8),
+                 jnp.zeros(shape3, jnp.float32))
                 for _ in range(model.num_layers))
         else:
             self._cache = tuple(
-                (jnp.zeros((s, L, h, d), dt), jnp.zeros((s, L, h, d), dt))
+                (jnp.zeros(shape4, dt), jnp.zeros(shape4, dt))
                 for _ in range(model.num_layers))
         self._pos = np.zeros(s, np.int32)
         self._tok = np.zeros(s, np.int32)
         self._live: List[Optional[_Request]] = [None] * s
         self._pending: "Queue[_Request]" = Queue()
+        # loop-thread-only FIFO between intake and admission: paged mode
+        # may defer the queue head until enough pages free up
+        self._buffer: "deque[_Request]" = deque()
         self._running = threading.Event()
         self._stopped = False
+        # serializes the stopped-check+enqueue in submit() against stop()'s
+        # drain: without it a submit racing stop can enqueue after the
+        # drain, leaving a stream whose consumer blocks forever
+        self._submit_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._step = jax.jit(
-            lambda v, t, c, p: self.model.apply(
-                v, t, c, p, method=self.model.decode_step))
+            lambda v, t, c, p, pt: self.model.apply(
+                v, t, c, p, pt, method=self.model.decode_step))
         # whole-slot overwrite: a newly admitted request's padded cache
         # rows replace slot `i` across every layer in one jitted update
         self._load = jax.jit(
             lambda c, rows, i: jax.tree.map(
                 lambda dst, src: dst.at[i].set(src[0].astype(dst.dtype)),
                 c, rows))
+        # paged admit: prefill rows reshape into [MP, page, ...] blocks
+        # and scatter into the pools at this slot's page ids; blocks past
+        # the allocation carry the OUT-OF-RANGE id NP so mode="drop"
+        # discards them (NOT -1: jax wraps negative indices numpy-style
+        # BEFORE the bounds check, which would corrupt the last page)
+        self._load_paged = jax.jit(
+            lambda c, rows, ids: jax.tree.map(
+                lambda pool, r: pool.at[ids].set(
+                    r[0].reshape(ids.shape[0], pool.shape[1],
+                                 *r.shape[2:]).astype(pool.dtype),
+                    mode="drop"),
+                c, rows))
+
+    def _worst_pages(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case page count for one request — THE reservation
+        invariant: submit()'s rejection and _try_admit()'s reservation
+        must both use exactly this, or just-in-time growth in the loop
+        can pop an empty free list mid-decode."""
+        return min(-(-(prompt_len + max_new) // self.page_size), self._mp)
 
     # ---- client side ---------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -124,21 +186,43 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {len(prompt)} + {max_new_tokens} exceeds "
                 f"max_len {self.model.max_len}")
-        if self._stopped:
-            # a late submit racing stop() would otherwise wait forever on
-            # a stream nobody will ever close
-            raise RuntimeError("ContinuousBatcher is stopped")
+        if self.paged:
+            worst = self._worst_pages(len(prompt), int(max_new_tokens))
+            if worst > self._np - 1:
+                raise ValueError(
+                    f"request needs up to {worst} pages but the pool has "
+                    f"{self._np - 1}; raise num_pages")
         req = _Request(prompt, max_new_tokens, eos_id)
-        self._pending.put(req)
+        with self._submit_lock:
+            if self._stopped:
+                # a late submit racing stop() would otherwise wait forever
+                # on a stream nobody will ever close
+                raise RuntimeError("ContinuousBatcher is stopped")
+            self._pending.put(req)
         return req.stream
 
     def stream_text(self, tokenizer, text: str,
                     max_new_tokens: int = 32) -> Iterator[str]:
-        """serving.stream_reply-ready: text in, decoded token chunks out."""
+        """serving.stream_reply-ready: text in, decoded word chunks out.
+
+        Ids buffer until a token COMPLETES a word (tokenizer.is_word_end:
+        its vocab string carries the end-of-word marker, or eos), then the
+        whole word decodes as one piece — a word split across BPE subword
+        tokens must never stream with spaces inside it.  Tokenizers
+        without the concept degrade to per-token emission."""
         ids = tokenizer.encode(text, append_eos=False)
+        word_end = getattr(tokenizer, "is_word_end", lambda _t: True)
+        buf: List[int] = []
         for tok in self.submit(ids, max_new_tokens,
                                eos_id=tokenizer.eos_id):
-            piece = tokenizer.decode([tok])
+            buf.append(tok)
+            if word_end(tok):
+                piece = tokenizer.decode(buf)
+                buf.clear()
+                if piece:
+                    yield piece + " "
+        if buf:  # stream ended mid-word (max_new_tokens hit)
+            piece = tokenizer.decode(buf)
             if piece:
                 yield piece + " "
 
@@ -151,14 +235,32 @@ class ContinuousBatcher:
         return self
 
     def stop(self):
-        self._stopped = True
+        with self._submit_lock:
+            # after this block no submit() can enqueue, so the drain
+            # below is complete
+            self._stopped = True
         self._running.clear()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            # the drain below treats _buffer/_live as single-owner, so the
+            # loop thread must actually be DEAD first — one tick can
+            # legitimately take tens of seconds (first XLA compile of a
+            # new prefill bucket over a tunneled chip), so keep joining
+            # well past that before declaring the loop wedged
+            deadline = 300.0
+            while self._thread.is_alive() and deadline > 0:
+                self._thread.join(timeout=10)
+                deadline -= 10
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    "ContinuousBatcher loop thread failed to exit within "
+                    "300s; refusing to drain its queues concurrently")
         # unblock any consumers still waiting on admitted streams
         for req in self._live:
             if req is not None:
                 req.stream._q.put(None)
+        for req in self._buffer:  # loop thread is dead; buffer is ours now
+            req.stream._q.put(None)
+        self._buffer.clear()
         while True:
             try:
                 self._pending.get_nowait().stream._q.put(None)
@@ -168,11 +270,38 @@ class ContinuousBatcher:
     def _admit(self, slot: int, req: _Request):
         from ..models.generation import _prefill_cache
 
+        # bucket prompt lengths to powers of two so admission compiles
+        # O(log max_len) prefill shapes total instead of one per distinct
+        # length (seconds-long XLA stalls in the serving hot path).  The
+        # padded tail is sound: causal masking keeps positions < n exact,
+        # and the garbage K/V rows >= n are never attendable — a decode
+        # step at pos p masks rows > p and overwrites row p itself first.
+        n = len(req.prompt)
+        b = 16
+        while b < n:
+            b *= 2
+        b = min(b, self.model.max_len)
+        padded = np.zeros(b, np.int32)
+        padded[:n] = req.prompt
         logits, cache = _prefill_cache(self.model, self.variables,
-                                       jnp.asarray(req.prompt[None]),
+                                       jnp.asarray(padded[None]),
                                        self.kv_cache_dtype)
-        self._cache = self._load(self._cache, cache, slot)
-        first = int(jnp.argmax(logits[0, -1]))
+        if self.paged:
+            # allocate this slot's prompt pages and scatter the prefill
+            # rows into them; bucketing garbage rows inside the last page
+            # are masked/overwritten exactly as in the dense layout
+            need = -(-n // self.page_size)
+            pages = [self._free.pop() for _ in range(need)]
+            self._slot_pages[slot] = pages
+            self._table[slot].fill(0)
+            self._table[slot, :need] = pages
+            ids = np.full(self._mp, self._np, np.int32)  # NP = dropped
+            ids[:need] = pages
+            self._cache = self._load_paged(self._cache, cache,
+                                           jnp.asarray(ids))
+        else:
+            self._cache = self._load(self._cache, cache, slot)
+        first = int(jnp.argmax(logits[0, n - 1]))
         self._live[slot] = req
         self._pos[slot] = len(req.prompt)
         self._tok[slot] = first
@@ -188,33 +317,73 @@ class ContinuousBatcher:
         if done:
             req.stream._q.put(None)
             self._live[slot] = None
+            if self.paged:  # return pages + release the reservation
+                self._free.extend(self._slot_pages[slot])
+                self._slot_pages[slot] = []
+                self._table[slot].fill(0)
+                self._avail += self._slot_reserved[slot]
+                self._slot_reserved[slot] = 0
+
+    def _drain_intake(self):
+        while True:
+            try:
+                self._buffer.append(self._pending.get_nowait())
+            except Empty:
+                return
+
+    def _try_admit(self):
+        """Admit from the FIFO head into free slots.  Paged mode admits
+        only while the head's worst-case page reservation fits the
+        unreserved budget — strict FIFO (no skipping), so a big request
+        can't be starved by a stream of small ones."""
+        for slot in range(self.max_slots):
+            if not self._buffer:
+                return
+            if self._live[slot] is not None:
+                continue
+            req = self._buffer[0]
+            if self.paged:
+                worst = self._worst_pages(len(req.prompt), req.max_new)
+                if worst > self._avail:
+                    return
+                self._avail -= worst
+                self._slot_reserved[slot] = worst
+            self._buffer.popleft()
+            self._admit(slot, req)
 
     def _loop(self):
         while self._running.is_set():
-            # admit as many pending requests as there are free slots
-            for slot in range(self.max_slots):
-                if self._live[slot] is None:
-                    try:
-                        req = self._pending.get_nowait()
-                    except Empty:
-                        break
-                    self._admit(slot, req)
+            self._drain_intake()
+            self._try_admit()
             active = [s for s in range(self.max_slots)
                       if self._live[s] is not None]
             if not active:
-                try:
-                    req = self._pending.get(timeout=self.idle_sleep_s)
-                except Empty:
-                    continue
-                self._admit(0, req)
-                active = [0] if self._live[0] is not None else []
-                if not active:
-                    continue
+                if not self._buffer:
+                    try:
+                        self._buffer.append(
+                            self._pending.get(timeout=self.idle_sleep_s))
+                    except Empty:
+                        continue
+                # nothing live -> every reservation is released, so the
+                # head always fits; the next iteration admits it
+                continue
+            if self.paged:
+                # grow each active slot's page list just-in-time for this
+                # tick's write position (the admission reservation
+                # guarantees the free list can cover it)
+                for sl in active:
+                    idx = int(self._pos[sl]) // self.page_size
+                    if idx >= len(self._slot_pages[sl]):
+                        pg = self._free.pop()
+                        self._slot_pages[sl].append(pg)
+                        self._table[sl, idx] = pg
             # ONE batched step for every slot (free slots compute too —
-            # their pos 0 writes are dead, an admit overwrites the rows)
+            # their pos 0 writes are dead: dense mode overwrites the rows
+            # on admit, paged mode routes them to the trash page)
             lg, self._cache = self._step(
                 self.variables, jnp.asarray(self._tok)[:, None],
-                self._cache, jnp.asarray(self._pos))
+                self._cache, jnp.asarray(self._pos),
+                jnp.asarray(self._table) if self.paged else None)
             nxt = np.asarray(jnp.argmax(lg[:, 0], axis=-1), np.int32)
             for slot in active:
                 self._pos[slot] += 1

@@ -275,3 +275,128 @@ def test_generate_stream_one_call_endpoint(lm):
 
     with pytest.raises(RuntimeError, match="stopped"):
         query._batcher.submit([1, 2], max_new_tokens=2)
+
+
+def test_stream_text_never_splits_words():
+    """ADVICE r3 (medium): a word split across BPE subword tokens must
+    stream as ONE piece — the concatenated stream equals decode() of the
+    raw ids, with spaces only at word boundaries."""
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.featurize.tokenizer import BPETokenizer
+
+    corpus = Table({"text": ["hello world hello there",
+                             "world hello there world"]})
+    tok = BPETokenizer(vocab_size=18).fit(corpus)
+    # a vocab this small leaves multi-token words (the advisor's repro)
+    assert any(len(tok._encode_word(w)) > 1 for w in ("hello", "world"))
+    model = transformer_lm(vocab_size=len(tok.vocab), embed_dim=32,
+                           num_layers=2, num_heads=2, max_len=64,
+                           dtype=jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.zeros((1, 4), jnp.int32), train=False)
+    variables = {c: v for c, v in variables.items() if c != "kvcache"}
+    batcher = ContinuousBatcher(model, variables, max_slots=2).start()
+    try:
+        pieces = list(batcher.stream_text(tok, "hello world",
+                                          max_new_tokens=10))
+        ids = batcher.submit(tok.encode("hello world", append_eos=False),
+                             max_new_tokens=10,
+                             eos_id=tok.eos_id).tokens()
+    finally:
+        batcher.stop()
+    assert pieces, "stream yielded nothing"
+    assert all(" " not in p.rstrip() for p in pieces), pieces
+    assert "".join(pieces).strip() == tok.decode(ids)
+
+
+def test_prefill_shapes_bucketed(lm):
+    """ADVICE r3: admission pads prompts to power-of-two buckets so the
+    serving hot path compiles O(log max_len) prefill shapes — prompts of
+    different lengths within a bucket must produce EXACT generate()
+    outputs (the padded tail is causally invisible)."""
+    model, variables = lm
+    batcher = ContinuousBatcher(model, variables, max_slots=2).start()
+    try:
+        # lengths 1..6 all land in the 16-bucket; outputs must stay exact
+        prompts = [[5], [3, 1], [2, 7, 1], [1, 5, 9, 2], [8] * 5, [4] * 6]
+        streams = [batcher.submit(p, max_new_tokens=4) for p in prompts]
+        got = [s.tokens() for s in streams]
+    finally:
+        batcher.stop()
+    for p, toks in zip(prompts, got):
+        assert toks == _reference(model, variables, p, 4), (p, toks)
+
+
+# ------------------------------------------------------------- paged KV
+
+def test_paged_streams_match_generate(lm):
+    """Paged-KV exactness oracle: with page pools + page table, every
+    stream's tokens are EXACTLY generate()'s, across admits/finishes that
+    recycle pages between co-tenant streams."""
+    model, variables = lm
+    prompts = [[3, 1, 4], [1, 5, 9, 2, 6], [5], [3, 5, 8, 9],
+               [2, 7, 1, 8, 2, 8], [9, 9, 1]]
+    n_new = [6, 9, 4, 7, 5, 8]
+    batcher = ContinuousBatcher(model, variables, max_slots=2, paged=True,
+                                page_size=8, num_pages=13).start()
+    try:
+        streams = [batcher.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, n_new)]
+        got = [s.tokens() for s in streams]
+    finally:
+        batcher.stop()
+    for p, n, toks in zip(prompts, n_new, got):
+        assert toks == _reference(model, variables, p, n), (p, toks)
+    # every page went back to the free list (page 0 stays trash)
+    assert sorted(batcher._free) == list(range(1, batcher._np))
+    assert batcher._avail == batcher._np - 1
+
+
+def test_paged_int8_matches_generate_int8(lm):
+    """Paging composes with the int8 KV cache: pooled int8 rows + scales
+    reproduce generate(kv_cache_dtype='int8') bit for bit."""
+    import jax.numpy as jnp  # noqa: F811
+
+    model, variables = lm
+    prompts = [[4, 4, 2], [7, 1, 1, 3], [2, 9]]
+    batcher = ContinuousBatcher(model, variables, max_slots=2, paged=True,
+                                page_size=8, kv_cache_dtype="int8").start()
+    try:
+        streams = [batcher.submit(p, max_new_tokens=6) for p in prompts]
+        got = [s.tokens() for s in streams]
+    finally:
+        batcher.stop()
+    for p, toks in zip(prompts, got):
+        ref = np.asarray(generate(
+            model, variables, jnp.asarray(p)[None], max_new_tokens=6,
+            kv_cache_dtype="int8"))[0, len(p):].tolist()
+        assert toks == ref, (p, toks, ref)
+
+
+def test_paged_admission_defers_until_pages_free(lm):
+    """A pool too small for two worst-case tenants serializes them (strict
+    FIFO reservation) instead of corrupting pages — and both streams stay
+    exact."""
+    model, variables = lm
+    # worst case per request: ceil((5 + 10) / 8) = 2 pages; pool of 3
+    # usable pages fits ONE tenant at a time
+    batcher = ContinuousBatcher(model, variables, max_slots=2, paged=True,
+                                page_size=8, num_pages=4).start()
+    try:
+        a = batcher.submit([1, 2, 3, 4, 5], max_new_tokens=10)
+        b2 = batcher.submit([6, 7, 8, 9, 1], max_new_tokens=10)
+        got_a, got_b = a.tokens(), b2.tokens()
+    finally:
+        batcher.stop()
+    assert got_a == _reference(model, variables, [1, 2, 3, 4, 5], 10)
+    assert got_b == _reference(model, variables, [6, 7, 8, 9, 1], 10)
+
+
+def test_paged_oversized_request_rejected(lm):
+    model, variables = lm
+    batcher = ContinuousBatcher(model, variables, max_slots=1, paged=True,
+                                page_size=8, num_pages=3)
+    import pytest
+
+    with pytest.raises(ValueError, match="pages"):
+        batcher.submit([1] * 20, max_new_tokens=20)  # needs 5 > 2 pages
