@@ -32,16 +32,14 @@ def _cooccurrence(B):
 
 
 @jax.jit
-def _jaccard(C):
-    diag = jnp.diag(C)
-    denom = diag[:, None] + diag[None, :] - C
+def _jaccard(C, counts):
+    denom = counts[:, None] + counts[None, :] - C
     return jnp.where(denom > 0, C / jnp.maximum(denom, 1e-12), 0.0)
 
 
 @jax.jit
-def _lift(C):
-    diag = jnp.diag(C)
-    denom = diag[:, None] * diag[None, :]
+def _lift(C, counts):
+    denom = counts[:, None] * counts[None, :]
     return jnp.where(denom > 0, C / jnp.maximum(denom, 1e-12), 0.0)
 
 
@@ -95,24 +93,27 @@ class SAR(Estimator):
         affinity = np.zeros((n_users, n_items), np.float32)
         np.add.at(affinity, (users, items), ratings * decay)
 
-        # item-item co-occurrence on device (one MXU matmul)
+        # item-item co-occurrence on device (one MXU matmul).  Semantics
+        # are reference-exact (SAR.scala:185-199, verified against the
+        # committed sim_{count,jac,lift}{1,3} fixtures): the support
+        # threshold zeroes entries whose RAW co-occurrence is below it —
+        # including the diagonal (cooc(i,i) = occ(i)) — while surviving
+        # entries divide by the raw counts; the diagonal is kept (seen-item
+        # masking, not a zeroed diagonal, is what stops self-recommendation).
         B = jnp.asarray((affinity > 0).astype(np.float32))
         C = _cooccurrence(B)
-        counts = jnp.diag(C)  # item occurrence counts, saved before threshold
-        C = jnp.where(C >= float(self.support_threshold), C, 0.0)
-        # keep self-co-occurrence for the similarity denominators
-        C = C.at[jnp.diag_indices(C.shape[0])].set(counts)
+        counts = jnp.diag(C)  # occ(i): co-occurrence of an item with itself
 
         fn = self.similarity_function
         if fn == "jaccard":
-            S = _jaccard(C)
+            S = _jaccard(C, counts)
         elif fn == "lift":
-            S = _lift(C)
+            S = _lift(C, counts)
         elif fn == "cooccurrence":
             S = C
         else:
             raise ValueError(f"unknown similarity_function {fn!r}")
-        S = S.at[jnp.diag_indices(S.shape[0])].set(0.0)
+        S = jnp.where(C >= float(self.support_threshold), S, 0.0)
 
         return SARModel(
             user_affinity=affinity,
