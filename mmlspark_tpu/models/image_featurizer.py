@@ -46,6 +46,10 @@ class ImageFeaturizer(Transformer):
     use_pallas = Param("fused Mosaic preprocessing kernel: None = auto "
                        "(single-device TPU only), False = always XLA",
                        default=None)
+    pad_to_batch = Param(
+        "pad every device chunk to the full batch_size (one compiled shape "
+        "forever — the serving setting; see TPUModel.pad_to_batch)",
+        default=False, converter=TypeConverters.to_bool)
 
     def __init__(self, bundle: Optional[ModelBundle] = None, **kw):
         super().__init__(**kw)
@@ -78,6 +82,7 @@ class ImageFeaturizer(Transformer):
             preprocess=pre,
             group_by_shape=True,
             feed_dtype="uint8",
+            pad_to_batch=self.pad_to_batch,
         )
 
     def _transform(self, table: Table) -> Table:

@@ -175,6 +175,12 @@ class TPUModel(Transformer):
         default=False, converter=TypeConverters.to_bool)
     feed_dtype = Param("host->device transfer dtype (float32|uint8|int32 — "
                        "int32 for token-id models)", default="float32")
+    pad_to_batch = Param(
+        "always pad chunks to the full batch_size so every call shares ONE "
+        "compiled program shape — the serving setting: request batches "
+        "arrive in arbitrary sizes and each previously-unseen size would "
+        "otherwise trigger a fresh XLA compile in the hot path",
+        default=False, converter=TypeConverters.to_bool)
 
     def __init__(self, bundle: Optional[ModelBundle] = None, **kw):
         super().__init__(**kw)
@@ -255,6 +261,8 @@ class TPUModel(Transformer):
         ImageFeaturizer's streaming byte path so the two can never compile
         different program shapes for the same data."""
         bs = -(-max(self.batch_size, dp) // dp) * dp
+        if self.pad_to_batch:
+            return bs, bs
         return bs, (bs if n_rows > bs else dp)
 
     def run_chunk_iter(self, chunk_iter, jitted, dev_vars, mesh) -> List[np.ndarray]:

@@ -36,6 +36,9 @@ timeout 1800 python tools/mfu_sweep.py --decode 2>&1 | tee "tools/chip_logs/${ts
 log batcher-sweep
 timeout 1800 python tools/mfu_sweep.py --batcher 2>&1 | tee "tools/chip_logs/${ts}-batcher-sweep.log"
 
+log serving-sweep
+timeout 1800 python tools/mfu_sweep.py --serving 2>&1 | tee "tools/chip_logs/${ts}-serving-sweep.log"
+
 log tpu-tests
 timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py -q \
     2>&1 | tee "tools/chip_logs/${ts}-tpu-tests.log"

@@ -243,9 +243,20 @@ def batcher_child() -> int:
             jnp.asarray(prompt[None], jnp.int32)).items() if c != "kvcache"}
     n_new = 64
     results = {}
-    for n_streams in (1, 8):
+    for tag, n_streams, kw in (
+            ("1_streams", 1, {}),
+            ("8_streams", 8, {}),
+            # paged KV at the same co-tenancy: throughput delta vs the
+            # dense slot cache, with the pool sized to the WORKLOAD
+            # (Σ worst-case pages) instead of max_slots * max_len — the
+            # density the paging buys is the kv_hbm_bytes ratio below
+            ("8_streams_paged", 8, {"paged": True, "page_size": 64}),
+    ):
+        if kw.get("paged"):
+            worst = -(-(len(prompt) + n_new) // kw["page_size"])
+            kw["num_pages"] = 8 * worst + 2  # workload-sized pool (+warm)
         batcher = ContinuousBatcher(model, variables,
-                                    max_slots=max(n_streams, 1)).start()
+                                    max_slots=max(n_streams, 1), **kw).start()
         try:
             # warm: compile prefill + step
             batcher.submit(prompt, max_new_tokens=2).tokens()
@@ -256,11 +267,111 @@ def batcher_child() -> int:
             dt = _time.perf_counter() - t0
         finally:
             batcher.stop()
-        results[f"tok_per_sec_{n_streams}_streams"] = round(total / dt, 1)
+        results[f"tok_per_sec_{tag}"] = round(total / dt, 1)
+        results[f"kv_hbm_bytes_{tag}"] = sum(
+            int(leaf.size) * leaf.dtype.itemsize
+            for layer in batcher._cache for leaf in layer)
     results["batching_speedup"] = round(
         results["tok_per_sec_8_streams"] / results["tok_per_sec_1_streams"], 2)
+    results["paged_throughput_ratio"] = round(
+        results["tok_per_sec_8_streams_paged"]
+        / results["tok_per_sec_8_streams"], 2)
+    results["paged_hbm_ratio"] = round(
+        results["kv_hbm_bytes_8_streams_paged"]
+        / results["kv_hbm_bytes_8_streams"], 3)
     results["device"] = jax.devices()[0].device_kind
     print(json.dumps(results))
+    return 0
+
+
+def serving_child() -> int:
+    """BASELINE.json config 5: a continuous-batched ResNet-50
+    ImageFeaturizer endpoint with the accelerator IN the loop — clients
+    POST base64 JPEGs over keep-alive loopback HTTP, the server drains
+    opportunistic batches, decodes natively, featurizes on device
+    (pad_to_batch: one compiled shape forever), replies the 2048-d pooled
+    vector.  Prints p50/p99/QPS; the chip row for benchmarks_serving.csv."""
+    _pin_platform()
+    import base64
+    import http.client
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    import bench as _bench
+    from mmlspark_tpu.core.pipeline import LambdaTransformer, PipelineModel
+    from mmlspark_tpu.models.bundle import FlaxBundle
+    from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+    from mmlspark_tpu.serving.server import ServingServer
+
+    import jax
+
+    n_clients, per_client = 8, 25
+    if os.environ.get("SERVING_SWEEP_SMALL"):  # CPU smoke override
+        n_clients, per_client = 2, 4
+
+    bundle = FlaxBundle("resnet50", {"num_classes": 1000},
+                        input_shape=(224, 224, 3))
+    feat = ImageFeaturizer(bundle=bundle, input_col="image_bytes",
+                           output_col="features", batch_size=32,
+                           pad_to_batch=True)
+    b64_decode = LambdaTransformer(lambda t: t.with_column(
+        "image_bytes", np.asarray(
+            [base64.b64decode(s) for s in t["image"]], dtype=object)))
+    srv = ServingServer(model=PipelineModel([b64_decode, feat]),
+                        reply_col="features", name="img", path="/featurize",
+                        max_batch=32, batch_timeout_ms=5.0)
+    info = srv.start()
+
+    jpeg = bytes(_bench._synthetic_jpeg_table(1)["image"][0])
+    body = json.dumps({"image": base64.b64encode(jpeg).decode()}).encode()
+    hdrs = {"Content-Type": "application/json"}
+    lat = np.zeros((n_clients, per_client))
+    errors = []
+
+    def client(ci):
+        try:
+            conn = http.client.HTTPConnection(info.host, info.port)
+            for i in range(per_client):
+                t0 = _time.perf_counter()
+                conn.request("POST", "/featurize", body, hdrs)
+                resp = conn.getresponse()
+                payload = resp.read()
+                lat[ci, i] = _time.perf_counter() - t0
+                assert resp.status == 200, (resp.status, payload[:200])
+            conn.close()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append((ci, repr(e)))
+
+    try:
+        # warm: compiles the single padded [32,224,224,3] program
+        wconn = http.client.HTTPConnection(info.host, info.port)
+        wconn.request("POST", "/featurize", body, hdrs)
+        assert wconn.getresponse().read()
+        wconn.close()
+        t0 = _time.perf_counter()
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        wall = _time.perf_counter() - t0
+    finally:
+        srv.stop()
+    if errors or not np.all(lat > 0):
+        print(json.dumps({"error": f"clients failed/hung: {errors[:3]}"}))
+        return 1
+    flat = lat.reshape(-1) * 1000.0
+    print(json.dumps({
+        "serving_chip_p50_ms": round(float(np.percentile(flat, 50)), 2),
+        "serving_chip_p99_ms": round(float(np.percentile(flat, 99)), 2),
+        "serving_chip_qps": round(n_clients * per_client / wall, 1),
+        "batches": srv.stats["batches"],
+        "requests": srv.stats["requests"],
+        "device": jax.devices()[0].device_kind,
+    }))
     return 0
 
 
@@ -273,6 +384,9 @@ def main():
                     help="batch-1 decode tokens/sec, f32 vs prequant int8")
     ap.add_argument("--batcher", action="store_true",
                     help="continuous-batching tokens/sec, 1 vs 8 streams")
+    ap.add_argument("--serving", action="store_true",
+                    help="ResNet-50 featurizer endpoint p50/p99/QPS, "
+                         "accelerator in the loop")
     ap.add_argument("--child", type=int, default=None)
     ap.add_argument("--builder", default="resnet50")
     args = ap.parse_args()
@@ -284,6 +398,8 @@ def main():
         return decode_child()
     if args.batcher:
         return batcher_child()
+    if args.serving:
+        return serving_child()
     for tag, batch, flags, builder in CONFIGS:
         if args.quick and tag not in QUICK:
             continue
