@@ -232,3 +232,40 @@ def test_explainer_roundtrip(tab):
 
     exp = TabularLIME(model=linear_model(), num_samples=64, seed=15)
     fuzz_transformer(exp, tab)
+
+
+def test_image_lime_on_featurizer_stack():
+    """The reference's deep-learning explainer glue e2e (ImageExplainers
+    test: ImageLIME over a real vision model): explain a class probability
+    produced by the FULL ImageFeaturizer -> head stack, not a toy scoring
+    lambda.  Random weights — the assertion is that the composed pipeline
+    drives LIME end to end with a well-formed, finite explanation per
+    superpixel."""
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.models.bundle import FlaxBundle
+    from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+
+    bundle = FlaxBundle("resnet18", {"num_classes": 3},
+                        input_shape=(64, 64, 3))
+    feat = ImageFeaturizer(bundle=bundle, input_col="image",
+                           output_col="logits", cut_output_layers=0,
+                           batch_size=16)
+
+    def probs(t):
+        import scipy.special as sp
+
+        p = sp.softmax(np.stack(
+            [np.asarray(v) for v in t["logits"]]), axis=-1)
+        return t.with_column("scores", p[:, 0].astype(np.float32))
+
+    stack = PipelineModel([feat, LambdaTransformer(probs)])
+
+    rng = np.random.default_rng(3)
+    imgs = np.empty(1, dtype=object)
+    imgs[0] = rng.random((64, 64, 3)).astype(np.float32)
+    out = ImageLIME(model=stack, num_samples=24, seed=5,
+                    cell_size=16.0).transform(Table({"image": imgs}))
+    coefs = np.asarray(out["explanation"][0])
+    n_segments = len(np.unique(slic_segments(imgs[0], (64 * 64) // 256)))
+    assert coefs.shape == (1, n_segments)
+    assert np.all(np.isfinite(coefs))
