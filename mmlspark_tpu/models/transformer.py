@@ -288,13 +288,26 @@ class _Block(nn.Module):
                     v_pool = v_pool.at[pg, off].set(
                         v[:, 0].astype(v_pool.dtype))
                     cache = (k_pool, v_pool)
-                    a = _cache_attention(
-                        q,
-                        _gqa_expand(k_pool[page_table].reshape(
-                            b, mp * page, hkv, d), h),
-                        _gqa_expand(v_pool[page_table].reshape(
-                            b, mp * page, hkv, d), h),
-                        pos[:, None], d)
+                    if _single_tpu():
+                        # paged_decode_attention owns kernel-vs-gather
+                        # dispatch (shape/VMEM gate + GQA expansion):
+                        # eligible shapes take the Mosaic page walk —
+                        # cache reads scale with LIVE pages — the rest
+                        # ride its XLA gather, same numerics
+                        from ..ops.paged_attention import (
+                            paged_decode_attention)
+
+                        a = paged_decode_attention(
+                            q[:, 0], k_pool, v_pool, page_table,
+                            pos)[:, None]
+                    else:
+                        a = _cache_attention(
+                            q,
+                            _gqa_expand(k_pool[page_table].reshape(
+                                b, mp * page, hkv, d), h),
+                            _gqa_expand(v_pool[page_table].reshape(
+                                b, mp * page, hkv, d), h),
+                            pos[:, None], d)
             elif len(cache) == 4:
                 from ..ops.quant import quantize_kv_row
 

@@ -1,0 +1,98 @@
+"""Paged-attention kernel parity: the Pallas page-walk (interpret mode
+on CPU) must match the XLA gather composition exactly — including trash-
+page garbage, recycled pages, and per-slot positions mid-page."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.ops.paged_attention import (
+    _paged_pallas,
+    _xla_paged,
+    paged_decode_attention,
+    paged_kernel_ok,
+)
+
+
+def _setup(b=3, h=4, d=128, np_=9, page=8, mp=4, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    # pools carry garbage EVERYWHERE (trash page 0 included) — masking,
+    # not zero-init, must be what keeps dead positions invisible
+    k_pool = jnp.asarray(rng.normal(size=(np_, page, h, d)), jnp.float32)
+    v_pool = jnp.asarray(rng.normal(size=(np_, page, h, d)), jnp.float32)
+    # slot 0: 2 live pages, mid-page pos; slot 1: 1 page; slot 2: all MP
+    table = jnp.asarray([[1, 2, 0, 0], [3, 0, 0, 0], [4, 5, 6, 7]],
+                        jnp.int32)
+    pos = jnp.asarray([11, 3, page * mp - 1], jnp.int32)
+    return q, k_pool, v_pool, table, pos
+
+
+def test_kernel_matches_xla_gather():
+    q, k_pool, v_pool, table, pos = _setup()
+    got = np.asarray(_paged_pallas(q, k_pool, v_pool, table, pos))
+    ref = np.asarray(_xla_paged(q, k_pool, v_pool, table, pos))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_matches_bf16_pools():
+    q, k_pool, v_pool, table, pos = _setup(seed=1)
+    q16 = q.astype(jnp.bfloat16)
+    kp, vp = k_pool.astype(jnp.bfloat16), v_pool.astype(jnp.bfloat16)
+    got = np.asarray(_paged_pallas(q16, kp, vp, table, pos))
+    ref = np.asarray(_xla_paged(q16, kp, vp, table, pos))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_kernel_pos_zero_single_row():
+    # a freshly admitted slot at pos 0: exactly one visible position
+    q, k_pool, v_pool, table, _ = _setup(seed=2)
+    pos = jnp.asarray([0, 0, 0], jnp.int32)
+    got = np.asarray(_paged_pallas(q, k_pool, v_pool, table, pos))
+    ref = np.asarray(_xla_paged(q, k_pool, v_pool, table, pos))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # with one visible position softmax is 1.0 on it: out == that v row
+    for b in range(3):
+        np.testing.assert_allclose(
+            got[b], np.asarray(v_pool)[int(table[b, 0]), 0], rtol=1e-5)
+
+
+def test_dispatch_predicate():
+    q, k_pool, *_ = _setup()
+    assert paged_kernel_ok(q, k_pool)
+    assert not paged_kernel_ok(q, k_pool[:, :, :2])      # GQA pool
+    q65 = jnp.zeros((2, 4, 65), jnp.float32)
+    assert not paged_kernel_ok(q65, jnp.zeros((4, 8, 4, 65), jnp.float32))
+
+
+def test_public_entry_falls_back_and_matches():
+    # a GQA pool (hkv=2 < h=4) fails paged_kernel_ok, so the public
+    # entry must route to the XLA gather — and the gather must expand
+    # the shared heads to match _gqa_expand's repeat semantics
+    from mmlspark_tpu.models.transformer import (_cache_attention,
+                                                 _gqa_expand)
+
+    rng = np.random.default_rng(3)
+    b, h, hkv, d, np_, page, mp = 2, 4, 2, 64, 5, 8, 2
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(np_, page, hkv, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(np_, page, hkv, d)), jnp.float32)
+    table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    pos = jnp.asarray([9, 14], jnp.int32)
+    assert not paged_kernel_ok(q, kp)
+    out = np.asarray(paged_decode_attention(q, kp, vp, table, pos))
+    # reference: the model's own GQA gather branch (_cache_attention)
+    ref = np.asarray(_cache_attention(
+        q[:, None], _gqa_expand(kp[table].reshape(b, mp * page, hkv, d), h),
+        _gqa_expand(vp[table].reshape(b, mp * page, hkv, d), h),
+        pos[:, None], d))[:, 0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_vmem_gate_rejects_oversized_pages():
+    # a page config whose working set exceeds the VMEM budget must route
+    # to the gather (Mosaic would reject it), even though the dims align
+    q = jnp.zeros((1, 32, 128), jnp.float32)
+    huge = jnp.zeros((2, 2048, 32, 128), jnp.float32)
+    assert not paged_kernel_ok(q, huge)

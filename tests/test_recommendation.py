@@ -199,7 +199,7 @@ def _load_demo_usage(include_ts: bool = False):
         cols["ts"] = np.array([
             datetime.strptime(r["timestamp"], "%Y/%m/%dT%H:%M:%S").replace(
                 tzinfo=timezone.utc).timestamp() for r in rows], np.float64)
-    return Table(cols), uidx, iidx, rows
+    return Table(cols), uidx, iidx
 
 
 @pytest.mark.parametrize("threshold,fn,fixture", [
@@ -221,7 +221,7 @@ def test_sar_similarity_parity_vs_reference_fixtures(threshold, fn, fixture):
 
     if not os.path.isdir(_REF_RES):
         pytest.skip("reference checkout not available")
-    table, _uidx, iidx, _rows = _load_demo_usage()
+    table, _uidx, iidx = _load_demo_usage()
     model = SAR(similarity_function=fn,
                 support_threshold=threshold).fit(table)
     S = np.asarray(model.item_similarity)
@@ -256,7 +256,7 @@ def test_sar_recommendation_parity_vs_reference_fixtures(fn, fixture):
 
     if not os.path.isdir(_REF_RES):
         pytest.skip("reference checkout not available")
-    table, uidx, iidx, _rows = _load_demo_usage(include_ts=True)
+    table, uidx, iidx = _load_demo_usage(include_ts=True)
     names = {i: p for p, i in iidx.items()}
     # startTime "2015/06/09T19:39:37" in the spec IS the corpus max, which
     # is what our reference-time default uses; coeff 30 days = default

@@ -40,7 +40,8 @@ log serving-sweep
 timeout 1800 python tools/mfu_sweep.py --serving 2>&1 | tee "tools/chip_logs/${ts}-serving-sweep.log"
 
 log tpu-tests
-timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py -q \
+timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py \
+    tests/test_paged_attention.py -q \
     2>&1 | tee "tools/chip_logs/${ts}-tpu-tests.log"
 
 echo "== chip session ${ts} complete; commit tools/chip_logs/ + BENCH_LASTGOOD.json"
