@@ -4,6 +4,7 @@ Reference: core/src/main/python/mmlspark/cyber/ (~1.7k LoC Py:
 anomaly/collaborative_filtering.py AccessAnomaly, complement_access.py,
 feature/ partitioned scalers and indexers).
 """
+from .dataset import DataFactory
 from .access_anomaly import (
     AccessAnomaly,
     AccessAnomalyModel,
@@ -18,6 +19,7 @@ from .feature import (
 )
 
 __all__ = [
+    "DataFactory",
     "AccessAnomaly",
     "AccessAnomalyModel",
     "ComplementAccessTransformer",

@@ -19,7 +19,29 @@ import numpy as np
 
 from ..core.schema import Table
 
-__all__ = ["read_binary_files", "read_csv"]
+__all__ = ["read_binary_files", "read_csv", "zip_iterator"]
+
+
+def zip_iterator(path: str, sample_ratio: float = 1.0, seed: int = 0):
+    """Yield (name, bytes) for every file entry of a zip archive, each
+    name prefixed with the archive path (StreamUtilities.ZipIterator,
+    core/env/StreamUtilities.scala:53-78): directories are skipped and
+    `sample_ratio` Bernoulli-samples entries before extraction — the
+    zipped-image-dataset ingestion path.
+    """
+    import zipfile
+
+    rng = random.Random(seed)
+    with zipfile.ZipFile(path) as zf:
+        for info in zf.infolist():
+            if info.is_dir() or rng.random() >= sample_ratio:
+                continue
+            data = zf.read(info)
+            if len(data) != info.file_size:
+                raise IOError(
+                    f"short read from zip entry {info.filename}: "
+                    f"{len(data)} of {info.file_size} bytes")
+            yield os.path.join(path, info.filename), data
 
 
 def read_binary_files(pattern: str, recursive: bool = True,

@@ -270,17 +270,28 @@ class _Block(nn.Module):
                     vq = vq.at[pg, off].set(vnew[:, 0])
                     vs = vs.at[pg, off].set(vsc[:, 0])
                     cache = (kq, ks, vq, vs)
-                    a = _cache_attention(
-                        q,
-                        _gqa_expand(kq[page_table].reshape(
-                            b, mp * page, hkv, d), h),
-                        _gqa_expand(vq[page_table].reshape(
-                            b, mp * page, hkv, d), h),
-                        pos[:, None], d,
-                        k_scale=_gqa_expand(ks[page_table].reshape(
-                            b, mp * page, hkv), h),
-                        v_scale=_gqa_expand(vs[page_table].reshape(
-                            b, mp * page, hkv), h))
+                    if _single_tpu():
+                        # dispatch owned by ops.paged_attention (see the
+                        # f32 branch below) — int8 page walk reads 1/4
+                        # the HBM bytes of f32 AND only live pages
+                        from ..ops.paged_attention import (
+                            paged_decode_attention_int8)
+
+                        a = paged_decode_attention_int8(
+                            q[:, 0], kq, ks, vq, vs, page_table,
+                            pos)[:, None]
+                    else:
+                        a = _cache_attention(
+                            q,
+                            _gqa_expand(kq[page_table].reshape(
+                                b, mp * page, hkv, d), h),
+                            _gqa_expand(vq[page_table].reshape(
+                                b, mp * page, hkv, d), h),
+                            pos[:, None], d,
+                            k_scale=_gqa_expand(ks[page_table].reshape(
+                                b, mp * page, hkv), h),
+                            v_scale=_gqa_expand(vs[page_table].reshape(
+                                b, mp * page, hkv), h))
                 else:
                     k_pool, v_pool = cache
                     k_pool = k_pool.at[pg, off].set(

@@ -132,3 +132,39 @@ def test_complement_dense_grid_enumerates():
     })
     comp = ComplementAccessTransformer(complement_ratio=1.0, seed=9).transform(t)
     assert len(comp) == 4  # found ALL unseen pairs despite 96% density
+
+
+def test_data_factory_splits_and_anomaly_separation():
+    """The reference's DataFactory test shape (cyber/dataset.py:110-151 +
+    test_collaborative_filtering): train on clustered in-department
+    access, then NEW in-department pairs (intra) must score lower than
+    cross-department pairs (inter)."""
+    from mmlspark_tpu.cyber import AccessAnomaly, DataFactory, IdIndexer
+
+    fac = DataFactory(seed=42)
+    train = fac.create_clustered_training_data(ratio=0.4)
+    intra = fac.create_clustered_intra_test_data(train)
+    inter = fac.create_clustered_inter_test_data()
+
+    # split invariants: intra pairs are new vs train; inter pairs cross
+    # departments
+    train_pairs = set(zip(train["user_id"], train["res_id"]))
+    assert not (set(zip(intra["user_id"], intra["res_id"])) & train_pairs)
+    assert all(u.split("_")[0] != r.split("_")[0]
+               for u, r in zip(inter["user_id"], inter["res_id"]))
+
+    user_ix = IdIndexer(input_col="user_id", output_col="user").fit(train)
+    res_ix = IdIndexer(input_col="res_id", output_col="res").fit(train)
+    index = lambda t: res_ix.transform(user_ix.transform(t))
+    model = AccessAnomaly(rank=6, max_iter=8, seed=0,
+                          likelihood_col="likelihood").fit(index(train))
+
+    def scores(t):
+        idx = index(t)
+        keep = (np.asarray(idx["user"]) >= 0) & (np.asarray(idx["res"]) >= 0)
+        return np.asarray(model.transform(idx.filter(keep))["anomaly_score"])
+
+    s_intra, s_inter = scores(intra), scores(inter)
+    assert len(s_intra) and len(s_inter)
+    assert float(np.mean(s_inter)) > float(np.mean(s_intra)) + 0.5, (
+        float(np.mean(s_intra)), float(np.mean(s_inter)))

@@ -182,3 +182,25 @@ def test_parquet_feeds_pipeline(tmp_path):
     model = LogisticRegression(max_iter=150).fit(t)
     out = model.transform(t)
     assert (np.asarray(out["prediction"]) == y).mean() > 0.9
+
+
+def test_zip_iterator_samples_and_reads(tmp_path):
+    """StreamUtilities.ZipIterator parity: (archive/entry, bytes) pairs,
+    directories skipped, Bernoulli sampling on entries."""
+    import os
+    import zipfile
+
+    from mmlspark_tpu.io.binary import zip_iterator
+
+    path = str(tmp_path / "data.zip")
+    blobs = {f"img_{i}.bin": bytes([i]) * (i + 1) for i in range(20)}
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.mkdir("subdir")
+        for name, b in blobs.items():
+            zf.writestr(f"subdir/{name}", b)
+    got = dict(zip_iterator(path))
+    assert len(got) == 20
+    for name, b in blobs.items():
+        assert got[os.path.join(path, "subdir", name)] == b
+    sampled = list(zip_iterator(path, sample_ratio=0.4, seed=3))
+    assert 0 < len(sampled) < 20

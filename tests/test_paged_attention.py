@@ -96,3 +96,48 @@ def test_vmem_gate_rejects_oversized_pages():
     q = jnp.zeros((1, 32, 128), jnp.float32)
     huge = jnp.zeros((2, 2048, 32, 128), jnp.float32)
     assert not paged_kernel_ok(q, huge)
+
+
+def _int8_setup(b=2, h=4, d=64, np_=7, page=8, mp=3, seed=4):
+    from mmlspark_tpu.ops.quant import quantize_kv_row
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    raw_k = jnp.asarray(rng.normal(size=(np_, page, h, d)), jnp.float32)
+    raw_v = jnp.asarray(rng.normal(size=(np_, page, h, d)), jnp.float32)
+    kq, ks = quantize_kv_row(raw_k)
+    vq, vs = quantize_kv_row(raw_v)
+    table = jnp.asarray([[1, 2, 0], [3, 4, 5]], jnp.int32)
+    pos = jnp.asarray([13, 20], jnp.int32)
+    return q, kq, ks, vq, vs, table, pos
+
+
+def test_int8_kernel_matches_xla_gather():
+    from mmlspark_tpu.ops.paged_attention import (_paged_pallas_int8,
+                                                  _xla_paged_int8)
+
+    q, kq, ks, vq, vs, table, pos = _int8_setup()
+    got = np.asarray(_paged_pallas_int8(q, kq, ks, vq, vs, table, pos))
+    ref = np.asarray(_xla_paged_int8(q, kq, ks, vq, vs, table, pos))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_int8_xla_gather_matches_cache_attention():
+    """The int8 fallback must reproduce the model's _cache_attention
+    quant factoring bit for bit on the gathered logical view."""
+    from mmlspark_tpu.models.transformer import _cache_attention
+    from mmlspark_tpu.ops.paged_attention import _xla_paged_int8
+
+    q, kq, ks, vq, vs, table, pos = _int8_setup(seed=5)
+    b, h, d = q.shape
+    np_, page, _, _ = kq.shape
+    mp = table.shape[1]
+    got = np.asarray(_xla_paged_int8(q, kq, ks, vq, vs, table, pos))
+    ref = np.asarray(_cache_attention(
+        q[:, None],
+        kq[table].reshape(b, mp * page, h, d),
+        vq[table].reshape(b, mp * page, h, d),
+        pos[:, None], d,
+        k_scale=ks[table].reshape(b, mp * page, h),
+        v_scale=vs[table].reshape(b, mp * page, h)))[:, 0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
