@@ -219,7 +219,9 @@ class _Block(nn.Module):
             if cache is None:
                 rp = jnp.arange(s)
             elif pos is not None and jnp.ndim(pos) == 1:
-                rp = pos[:, None]                  # [B, 1] slot positions
+                # per-slot positions; s>1 = slot BLOCK decode, row b's
+                # tokens sit at pos[b]..pos[b]+s-1
+                rp = pos[:, None] + jnp.arange(s)[None]
             else:
                 rp = pos + jnp.arange(s)
             q = _rope(q, rp)
@@ -236,18 +238,19 @@ class _Block(nn.Module):
             # matching heads; the CACHE below stays at hkv)
             a = self.attn_fn(q, _gqa_expand(k, h), _gqa_expand(v, h))
         elif pos is not None and jnp.ndim(pos) == 1:
-            # SLOT decode (continuous batching): x is [B, 1, E], pos [B] —
+            # SLOT decode (continuous batching): x is [B, s, E], pos [B] —
             # every slot sits at its OWN position (requests admitted at
-            # different times).  Writes are per-row scatters; the int8
-            # 4-tuple cache quantizes each written row exactly like the
-            # scalar path, so slot decode with int8 matches generate's
-            # int8 decode bit for bit (4x the co-tenant density per HBM
-            # byte — the serving composition that matters).
-            if s != 1:
-                raise ValueError(
-                    f"slot decode is single-token (got s={s}); block "
-                    "decode needs a scalar pos")
+            # different times).  s=1 is the per-tick autoregressive step;
+            # s>1 is slot BLOCK decode (per-slot speculative verification
+            # / chunked prefill): row b's tokens occupy positions
+            # pos[b]..pos[b]+s-1, query i masked to <= pos[b]+i.  Writes
+            # are per-row scatters; the int8 4-tuple cache quantizes each
+            # written row exactly like the scalar path, so slot decode
+            # with int8 matches generate's int8 decode bit for bit (4x
+            # the co-tenant density per HBM byte).
             rows_b = jnp.arange(b)
+            rows_mat = rows_b[:, None]                         # [B, 1]
+            posmat = pos[:, None] + jnp.arange(s)[None]        # [B, s]
             if page_table is not None:
                 # PAGED slot decode: write one row into the owning page,
                 # gather each slot's pages back into a logical [B, L, H, D]
@@ -256,8 +259,8 @@ class _Block(nn.Module):
                 # gather is XLA's — a Mosaic page-table kernel can replace
                 # it without touching this contract.
                 page = cache[0].shape[1]
-                pg = page_table[rows_b, pos // page]          # [B]
-                off = pos % page
+                pgmat = page_table[rows_mat, posmat // page]   # [B, s]
+                offmat = posmat % page
                 mp = page_table.shape[1]
                 if len(cache) == 4:
                     from ..ops.quant import quantize_kv_row
@@ -265,12 +268,12 @@ class _Block(nn.Module):
                     kq, ks, vq, vs = cache
                     knew, ksc = quantize_kv_row(k)
                     vnew, vsc = quantize_kv_row(v)
-                    kq = kq.at[pg, off].set(knew[:, 0])
-                    ks = ks.at[pg, off].set(ksc[:, 0])
-                    vq = vq.at[pg, off].set(vnew[:, 0])
-                    vs = vs.at[pg, off].set(vsc[:, 0])
+                    kq = kq.at[pgmat, offmat].set(knew)
+                    ks = ks.at[pgmat, offmat].set(ksc)
+                    vq = vq.at[pgmat, offmat].set(vnew)
+                    vs = vs.at[pgmat, offmat].set(vsc)
                     cache = (kq, ks, vq, vs)
-                    if _single_tpu():
+                    if s == 1 and _single_tpu():
                         # dispatch owned by ops.paged_attention (see the
                         # f32 branch below) — int8 page walk reads 1/4
                         # the HBM bytes of f32 AND only live pages
@@ -287,19 +290,19 @@ class _Block(nn.Module):
                                 b, mp * page, hkv, d), h),
                             _gqa_expand(vq[page_table].reshape(
                                 b, mp * page, hkv, d), h),
-                            pos[:, None], d,
+                            posmat, d,
                             k_scale=_gqa_expand(ks[page_table].reshape(
                                 b, mp * page, hkv), h),
                             v_scale=_gqa_expand(vs[page_table].reshape(
                                 b, mp * page, hkv), h))
                 else:
                     k_pool, v_pool = cache
-                    k_pool = k_pool.at[pg, off].set(
-                        k[:, 0].astype(k_pool.dtype))
-                    v_pool = v_pool.at[pg, off].set(
-                        v[:, 0].astype(v_pool.dtype))
+                    k_pool = k_pool.at[pgmat, offmat].set(
+                        k.astype(k_pool.dtype))
+                    v_pool = v_pool.at[pgmat, offmat].set(
+                        v.astype(v_pool.dtype))
                     cache = (k_pool, v_pool)
-                    if _single_tpu():
+                    if s == 1 and _single_tpu():
                         # paged_decode_attention owns kernel-vs-gather
                         # dispatch (shape/VMEM gate + GQA expansion):
                         # eligible shapes take the Mosaic page walk —
@@ -318,32 +321,32 @@ class _Block(nn.Module):
                                 b, mp * page, hkv, d), h),
                             _gqa_expand(v_pool[page_table].reshape(
                                 b, mp * page, hkv, d), h),
-                            pos[:, None], d)
+                            posmat, d)
             elif len(cache) == 4:
                 from ..ops.quant import quantize_kv_row
 
                 kq, ks, vq, vs = cache
                 knew, ksc = quantize_kv_row(k)
                 vnew, vsc = quantize_kv_row(v)
-                kq = kq.at[rows_b, pos].set(knew[:, 0])
-                ks = ks.at[rows_b, pos].set(ksc[:, 0])
-                vq = vq.at[rows_b, pos].set(vnew[:, 0])
-                vs = vs.at[rows_b, pos].set(vsc[:, 0])
+                kq = kq.at[rows_mat, posmat].set(knew)
+                ks = ks.at[rows_mat, posmat].set(ksc)
+                vq = vq.at[rows_mat, posmat].set(vnew)
+                vs = vs.at[rows_mat, posmat].set(vsc)
                 cache = (kq, ks, vq, vs)
                 a = _cache_attention(q, _gqa_expand(kq, h),
-                                     _gqa_expand(vq, h), pos[:, None], d,
+                                     _gqa_expand(vq, h), posmat, d,
                                      k_scale=_gqa_expand(ks, h),
                                      v_scale=_gqa_expand(vs, h))
             else:
                 k_cache, v_cache = cache
-                k_cache = k_cache.at[rows_b, pos].set(
-                    k[:, 0].astype(k_cache.dtype))
-                v_cache = v_cache.at[rows_b, pos].set(
-                    v[:, 0].astype(v_cache.dtype))
+                k_cache = k_cache.at[rows_mat, posmat].set(
+                    k.astype(k_cache.dtype))
+                v_cache = v_cache.at[rows_mat, posmat].set(
+                    v.astype(v_cache.dtype))
                 cache = (k_cache, v_cache)
                 a = _cache_attention(q, _gqa_expand(k_cache, h),
                                      _gqa_expand(v_cache, h),
-                                     pos[:, None], d)
+                                     posmat, d)
         elif len(cache) == 4:
             from ..ops.quant import quantize_kv_row
 
@@ -516,7 +519,8 @@ class TransformerLM(nn.Module):
             pe = nn.Embed(self.max_len, self.embed_dim, dtype=self.dtype,
                           name="pos_embed")
             if jnp.ndim(pos) == 1:        # slot mode: per-row positions
-                x = x + pe(pos)[:, None]
+                x = x + pe(pos[:, None]
+                           + jnp.arange(token.shape[1])[None])
             else:
                 x = x + pe(jnp.arange(token.shape[1]) + pos)[None]
         new_cache = []

@@ -69,16 +69,43 @@ class ContinuousBatcher:
     model: a TransformerLM; variables: its weights.  `max_slots` is the
     device batch width (a compile-time constant — one compiled step
     serves every mix of tenants).
+
+    `draft_model`/`draft_variables` turn on SPECULATIVE continuous
+    batching (vLLM-style): each tick the draft proposes `gamma` tokens
+    for every slot ((gamma+1) cheap slot steps on a dense draft cache),
+    then ONE target slot-BLOCK step verifies all slots' proposals at
+    their own positions — up to gamma+1 tokens emitted per slot per
+    target forward, outputs still EXACTLY generate()'s greedy tokens per
+    stream (the per-slot speculative-decoding argument, composed with
+    co-tenancy; tested).  The draft must share the target's vocabulary.
     """
 
     def __init__(self, model, variables, max_slots: int = 8,
                  idle_sleep_s: float = 0.001,
                  kv_cache_dtype: str = None,
                  paged: bool = False, page_size: int = 64,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 draft_model=None, draft_variables=None, gamma: int = 4):
         if kv_cache_dtype not in (None, "int8"):
             raise ValueError(f"kv_cache_dtype must be None or 'int8', "
                              f"got {kv_cache_dtype!r}")
+        if (draft_model is None) != (draft_variables is None):
+            raise ValueError("draft_model and draft_variables go together")
+        if draft_model is not None:
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError("draft and target must share a vocabulary")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            if model.moe_experts > 0 and model.moe_capacity < model.moe_experts:
+                # MoE expert capacity scales with the tokens per forward,
+                # so a [B, gamma+1] verify block could drop tokens that
+                # s=1 decode keeps — breaking the exactness contract.
+                # capacity_factor >= num_experts makes every block width
+                # drop-free (see TransformerLM.moe_capacity).
+                raise ValueError(
+                    "speculative batching with MoE needs drop-free "
+                    f"capacity: set moe_capacity >= moe_experts "
+                    f"({model.moe_experts}), got {model.moe_capacity}")
         self.model = model
         self.variables = {c: v for c, v in variables.items()
                           if c != "kvcache"}
@@ -86,6 +113,8 @@ class ContinuousBatcher:
         self.idle_sleep_s = float(idle_sleep_s)
         self.kv_cache_dtype = kv_cache_dtype
         self.paged = bool(paged)
+        self.draft_model = draft_model
+        self.gamma = int(gamma) if draft_model is not None else 0
         s, L = self.max_slots, model.max_len
         h = model.kv_heads
         d = model.embed_dim // model.num_heads
@@ -168,13 +197,35 @@ class ContinuousBatcher:
                                  *r.shape[2:]).astype(pool.dtype),
                     mode="drop"),
                 c, rows))
+        if draft_model is not None:
+            # speculative mode: the draft keeps a plain DENSE f32/bf16
+            # slot cache (it is the small/cheap model; paging and int8
+            # buy nothing there) at the same logical positions as the
+            # target's cache
+            self.draft_variables = {c: v for c, v in draft_variables.items()
+                                    if c != "kvcache"}
+            dL = draft_model.max_len
+            dh = draft_model.kv_heads
+            dd = draft_model.embed_dim // draft_model.num_heads
+            ddt = (jnp.float32 if draft_model.dtype == jnp.float32
+                   else draft_model.dtype)
+            self._d_cache = tuple(
+                (jnp.zeros((s, dL, dh, dd), ddt),
+                 jnp.zeros((s, dL, dh, dd), ddt))
+                for _ in range(draft_model.num_layers))
+            self._d_step = jax.jit(
+                lambda v, t, c, p: self.draft_model.apply(
+                    v, t, c, p, None, method=self.draft_model.decode_step))
 
     def _worst_pages(self, prompt_len: int, max_new: int) -> int:
         """Worst-case page count for one request — THE reservation
         invariant: submit()'s rejection and _try_admit()'s reservation
         must both use exactly this, or just-in-time growth in the loop
-        can pop an empty free list mid-decode."""
-        return min(-(-(prompt_len + max_new) // self.page_size), self._mp)
+        can pop an empty free list mid-decode.  Speculative mode writes
+        up to `gamma` rows past the emitted position per verify block,
+        so the reservation covers them too."""
+        return min(-(-(prompt_len + max_new + self.gamma)
+                     // self.page_size), self._mp)
 
     # ---- client side ---------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -182,10 +233,16 @@ class ContinuousBatcher:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
-        if len(prompt) + max_new_tokens > self.model.max_len:
+        limit = self.model.max_len - self.gamma
+        if self.draft_model is not None:
+            # draft writes ride to the same positions (+gamma lookahead)
+            limit = min(limit, self.draft_model.max_len - self.gamma)
+        if len(prompt) + max_new_tokens > limit:
             raise ValueError(
                 f"prompt {len(prompt)} + {max_new_tokens} exceeds "
-                f"max_len {self.model.max_len}")
+                f"max_len {self.model.max_len}"
+                + (f" - gamma {self.gamma} (speculative lookahead)"
+                   if self.gamma else ""))
         if self.paged:
             worst = self._worst_pages(len(prompt), int(max_new_tokens))
             if worst > self._np - 1:
@@ -281,11 +338,21 @@ class ContinuousBatcher:
         while b < n:
             b *= 2
         b = min(b, self.model.max_len)
+        if self.draft_model is not None:
+            b = min(b, self.draft_model.max_len)
         padded = np.zeros(b, np.int32)
         padded[:n] = req.prompt
         logits, cache = _prefill_cache(self.model, self.variables,
                                        jnp.asarray(padded[None]),
                                        self.kv_cache_dtype)
+        if self.draft_model is not None:
+            # the draft's cache must hold the same prompt history; its
+            # prefill logits are unused — the first pending token is the
+            # TARGET's (exactness requires it)
+            _dlg, d_rows = _prefill_cache(self.draft_model,
+                                          self.draft_variables,
+                                          jnp.asarray(padded[None]))
+            self._d_cache = self._load(self._d_cache, d_rows, slot)
         if self.paged:
             # allocate this slot's prompt pages and scatter the prefill
             # rows into them; bucketing garbage rows inside the last page
@@ -317,6 +384,11 @@ class ContinuousBatcher:
         if done:
             req.stream._q.put(None)
             self._live[slot] = None
+            # park the freed slot at position 0: a slot that finished
+            # near max_len must not leave a stale pos that speculative
+            # lookahead (pos + gamma) could push past the cache bound
+            self._pos[slot] = 0
+            self._tok[slot] = 0
             if self.paged:  # return pages + release the reservation
                 self._free.extend(self._slot_pages[slot])
                 self._slot_pages[slot] = []
@@ -369,14 +441,18 @@ class ContinuousBatcher:
                 continue
             if self.paged:
                 # grow each active slot's page list just-in-time for this
-                # tick's write position (the admission reservation
-                # guarantees the free list can cover it)
+                # tick's write positions — speculative mode writes up to
+                # pos + gamma (the admission reservation guarantees the
+                # free list can cover it)
                 for sl in active:
-                    idx = int(self._pos[sl]) // self.page_size
-                    if idx >= len(self._slot_pages[sl]):
+                    idx = (int(self._pos[sl]) + self.gamma) // self.page_size
+                    while idx >= len(self._slot_pages[sl]):
                         pg = self._free.pop()
+                        self._table[sl, len(self._slot_pages[sl])] = pg
                         self._slot_pages[sl].append(pg)
-                        self._table[sl, idx] = pg
+            if self.draft_model is not None:
+                self._speculative_tick(active)
+                continue
             # ONE batched step for every slot (free slots compute too —
             # their pos 0 writes are dead: dense mode overwrites the rows
             # on admit, paged mode routes them to the trash page)
@@ -389,3 +465,48 @@ class ContinuousBatcher:
                 self._pos[slot] += 1
                 self._tok[slot] = nxt[slot]
                 self._emit(slot, int(nxt[slot]))
+
+    def _speculative_tick(self, active):
+        """One speculative round for ALL slots: (gamma+1) draft slot
+        steps propose, ONE target slot-block step verifies, each slot
+        emits its accepted prefix + the target's own next token — the
+        per-slot speculative-decoding recurrence (speculative_generate's
+        round, vectorized over co-tenant slots).  The +1 extra draft
+        step writes the would-be-next K/V row so a fully-accepted round
+        leaves no hole in the draft cache."""
+        g = self.gamma
+        d_tok = jnp.asarray(self._tok)[:, None]
+        dpos = self._pos.copy()
+        prop_list = []
+        for i in range(g + 1):
+            lg, self._d_cache = self._d_step(
+                self.draft_variables, d_tok, self._d_cache,
+                jnp.asarray(dpos))
+            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+            if i < g:
+                # keep proposals ON DEVICE: a host sync here would block
+                # async dispatch of the next draft step
+                prop_list.append(nxt)
+            d_tok = nxt[:, None]
+            dpos += 1
+        props = np.asarray(jnp.stack(prop_list, axis=1), np.int32)  # [S, g]
+        # ONE target forward verifies every slot's pending token + its g
+        # proposals at the slot's own position: logits[:, j] predicts
+        # position pos+j+1
+        block = np.concatenate([self._tok[:, None], props], axis=1)
+        lg, self._cache = self._step(
+            self.variables, jnp.asarray(block), self._cache,
+            jnp.asarray(self._pos),
+            jnp.asarray(self._table) if self.paged else None)
+        t_pred = np.asarray(jnp.argmax(lg, axis=-1), np.int32)  # [S, g+1]
+        for slot in active:
+            match = t_pred[slot, :g] == props[slot]
+            m = int(np.argmin(np.concatenate(
+                [match, np.zeros(1, bool)])))                   # 0..g
+            for j in range(m + 1):
+                tok = int(props[slot, j]) if j < m else int(t_pred[slot, m])
+                self._pos[slot] += 1
+                self._tok[slot] = tok
+                self._emit(slot, tok)
+                if self._live[slot] is None:
+                    break  # finished mid-block: discard the rest

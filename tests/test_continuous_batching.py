@@ -400,3 +400,112 @@ def test_paged_oversized_request_rejected(lm):
 
     with pytest.raises(ValueError, match="pages"):
         batcher.submit([1] * 20, max_new_tokens=20)  # needs 5 > 2 pages
+
+
+# ------------------------------------------- speculative continuous batching
+
+@pytest.fixture(scope="module")
+def draft_lm(lm):
+    """A smaller draft sharing the target's vocabulary — initialized from
+    a DIFFERENT seed, so acceptance is imperfect and the rejection path
+    actually runs."""
+    model, _ = lm
+    draft = transformer_lm(vocab_size=model.vocab_size, embed_dim=16,
+                           num_layers=1, num_heads=2, max_len=48,
+                           dtype=jnp.float32)
+    dv = draft.init({"params": jax.random.PRNGKey(9)},
+                    jnp.zeros((1, 4), jnp.int32), train=False)
+    return draft, {c: v for c, v in dv.items() if c != "kvcache"}
+
+
+def test_speculative_batcher_matches_generate(lm, draft_lm):
+    """Speculative continuous batching oracle: with a draft proposing
+    per-slot blocks, every co-tenant stream's tokens are EXACTLY the
+    TARGET's greedy generate() — the draft only changes how many target
+    forwards it takes."""
+    model, variables = lm
+    draft, dv = draft_lm
+    prompts = [[3, 1, 4], [1, 5, 9, 2, 6], [5], [3, 5, 8, 9], [2, 7, 1]]
+    n_new = [6, 9, 4, 7, 8]
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                draft_model=draft, draft_variables=dv,
+                                gamma=3).start()
+    try:
+        streams = [batcher.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, n_new)]
+        got = [st.tokens() for st in streams]
+    finally:
+        batcher.stop()
+    for p, n, toks in zip(prompts, n_new, got):
+        assert toks == _reference(model, variables, p, n), (p, toks)
+
+
+def test_speculative_batcher_eos_and_paged(lm, draft_lm):
+    """Speculation composes with paged KV and eos early-stop, outputs
+    staying exact."""
+    model, variables = lm
+    draft, dv = draft_lm
+    ref = _reference(model, variables, [4, 4, 4], 10)
+    eos = ref[2]
+    batcher = ContinuousBatcher(model, variables, max_slots=2, paged=True,
+                                page_size=8, draft_model=draft,
+                                draft_variables=dv, gamma=3).start()
+    try:
+        toks = batcher.submit([4, 4, 4], max_new_tokens=10,
+                              eos_id=eos).tokens()
+        more = [batcher.submit(p, max_new_tokens=6)
+                for p in ([1, 2, 3], [9, 8, 7, 6])]
+        got_more = [st.tokens() for st in more]
+    finally:
+        batcher.stop()
+    assert toks == ref[:3] and toks[-1] == eos
+    for p, g2 in zip([[1, 2, 3], [9, 8, 7, 6]], got_more):
+        assert g2 == _reference(model, variables, p, 6), (p, g2)
+    assert sorted(batcher._free) == list(range(1, batcher._np))
+
+
+def test_speculative_perfect_draft_accepts_fully(lm):
+    """With the TARGET as its own draft every proposal matches: rounds
+    collapse to ~ceil(n/(gamma+1)) target forwards (counted via the
+    verify-step positions), and outputs stay exact."""
+    model, variables = lm
+    batcher = ContinuousBatcher(model, variables, max_slots=1,
+                                draft_model=model, draft_variables=variables,
+                                gamma=3).start()
+    ticks = {"n": 0}
+    orig = batcher._speculative_tick
+
+    def counting(active):
+        ticks["n"] += 1
+        return orig(active)
+
+    batcher._speculative_tick = counting
+    try:
+        toks = batcher.submit([3, 1, 4], max_new_tokens=8).tokens()
+    finally:
+        batcher.stop()
+    assert toks == _reference(model, variables, [3, 1, 4], 8)
+    # 8 tokens: 1 from prefill + 7 speculative; perfect acceptance emits
+    # gamma+1=4 per tick -> 2 ticks
+    assert ticks["n"] <= 3, ticks["n"]
+
+
+def test_speculative_submit_respects_gamma_headroom(lm, draft_lm):
+    model, variables = lm
+    draft, dv = draft_lm
+    batcher = ContinuousBatcher(model, variables, max_slots=1,
+                                draft_model=draft, draft_variables=dv,
+                                gamma=4)
+    with pytest.raises(ValueError, match="gamma"):
+        # 40 + 5 fits max_len 48 plainly but not with gamma-4 lookahead
+        batcher.submit([1] * 40, max_new_tokens=5)
+
+
+def test_speculative_moe_requires_dropfree_capacity(lm):
+    model, variables = lm
+    moe = transformer_lm(vocab_size=64, embed_dim=32, num_layers=1,
+                         num_heads=2, max_len=48, dtype=jnp.float32,
+                         moe_experts=4, moe_capacity=1.25)
+    with pytest.raises(ValueError, match="moe_capacity"):
+        ContinuousBatcher(moe, variables, draft_model=model,
+                          draft_variables=variables)

@@ -195,7 +195,7 @@ def test_zip_iterator_samples_and_reads(tmp_path):
     path = str(tmp_path / "data.zip")
     blobs = {f"img_{i}.bin": bytes([i]) * (i + 1) for i in range(20)}
     with zipfile.ZipFile(path, "w") as zf:
-        zf.mkdir("subdir")
+        zf.writestr(zipfile.ZipInfo("subdir/"), b"")  # explicit dir entry
         for name, b in blobs.items():
             zf.writestr(f"subdir/{name}", b)
     got = dict(zip_iterator(path))

@@ -274,6 +274,7 @@ def batcher_child() -> int:
             jnp.asarray(prompt[None], jnp.int32)).items() if c != "kvcache"}
     n_new = 64
     results = {}
+    spec_draft = None
     for tag, n_streams, kw in (
             ("1_streams", 1, {}),
             ("8_streams", 8, {}),
@@ -282,7 +283,21 @@ def batcher_child() -> int:
             # (Σ worst-case pages) instead of max_slots * max_len — the
             # density the paging buys is the kv_hbm_bytes ratio below
             ("8_streams_paged", 8, {"paged": True, "page_size": 64}),
+            # speculative continuous batching with the int8 self-draft
+            # (near-perfect acceptance, 1/4-bandwidth draft steps): the
+            # per-tick target forward amortizes over up to gamma+1 tokens
+            ("8_streams_spec", 8, {"spec": True}),
     ):
+        if kw.pop("spec", False):
+            if spec_draft is None:
+                from mmlspark_tpu.ops.quant import prequantize
+
+                dm = transformer_lm(dtype=jnp.float32, quant=True, **cfg)
+                spec_draft = (dm, prequantize(
+                    dm, dict(variables),
+                    jnp.asarray(prompt[None], jnp.int32)))
+            kw = dict(draft_model=spec_draft[0],
+                      draft_variables=spec_draft[1], gamma=4)
         if kw.get("paged"):
             worst = -(-(len(prompt) + n_new) // kw["page_size"])
             kw["num_pages"] = 8 * worst + 2  # workload-sized pool (+warm)
@@ -306,6 +321,9 @@ def batcher_child() -> int:
         results["tok_per_sec_8_streams"] / results["tok_per_sec_1_streams"], 2)
     results["paged_throughput_ratio"] = round(
         results["tok_per_sec_8_streams_paged"]
+        / results["tok_per_sec_8_streams"], 2)
+    results["spec_throughput_ratio"] = round(
+        results["tok_per_sec_8_streams_spec"]
         / results["tok_per_sec_8_streams"], 2)
     results["paged_hbm_ratio"] = round(
         results["kv_hbm_bytes_8_streams_paged"]
