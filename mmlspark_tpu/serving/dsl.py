@@ -154,19 +154,31 @@ class StreamReader:
 
     def generate_stream(self, model, variables, tokenizer=None,
                         max_new_tokens: int = 32, max_slots: int = 8,
-                        kv_cache_dtype=None) -> "StreamReader":
+                        kv_cache_dtype=None,
+                        paged: bool = False, page_size: int = 64,
+                        num_pages=None,
+                        draft_model=None, draft_variables=None,
+                        gamma: int = 4) -> "StreamReader":
         """The whole LM endpoint in one call: a ContinuousBatcher owns
         the decode (concurrent clients share one slotted device step) and
         stops with the query.  With a `tokenizer` (BPETokenizerModel),
         requests post {"prompt": "<text>"} and stream decoded text
         chunks; without one, {"prompt": [ids...]} streams token ids.
         The batcher is built PER start() call, so a builder can start
-        several independent queries."""
+        several independent queries.  `paged=True` serves from page
+        pools (pay-per-page KV HBM); `draft_model`/`draft_variables`
+        turn on speculative continuous batching (up to gamma+1 tokens
+        per slot per target forward, outputs exactly the target's)."""
         self._gen_cfg = dict(model=model, variables=variables,
                              tokenizer=tokenizer,
                              max_new_tokens=int(max_new_tokens),
                              max_slots=int(max_slots),
-                             kv_cache_dtype=kv_cache_dtype)
+                             kv_cache_dtype=kv_cache_dtype,
+                             paged=bool(paged), page_size=int(page_size),
+                             num_pages=num_pages,
+                             draft_model=draft_model,
+                             draft_variables=draft_variables,
+                             gamma=int(gamma))
         self._stream_fn = None
         return self
 
@@ -204,9 +216,13 @@ class StreamReader:
             from .batcher import ContinuousBatcher
 
             cfg = self._gen_cfg
+            # generate_stream populates every key; defaults live THERE
             batcher = ContinuousBatcher(
                 cfg["model"], cfg["variables"], max_slots=cfg["max_slots"],
-                kv_cache_dtype=cfg["kv_cache_dtype"])
+                kv_cache_dtype=cfg["kv_cache_dtype"], paged=cfg["paged"],
+                page_size=cfg["page_size"], num_pages=cfg["num_pages"],
+                draft_model=cfg["draft_model"],
+                draft_variables=cfg["draft_variables"], gamma=cfg["gamma"])
 
             def stream_fn(row, _b=batcher, _c=cfg):
                 if _c["tokenizer"] is not None:

@@ -509,3 +509,36 @@ def test_speculative_moe_requires_dropfree_capacity(lm):
     with pytest.raises(ValueError, match="moe_capacity"):
         ContinuousBatcher(moe, variables, draft_model=model,
                           draft_variables=variables)
+
+
+def test_generate_stream_one_call_paged_speculative(lm, draft_lm):
+    """The one-call endpoint passes paging + speculation through to the
+    batcher it owns — and streams stay generate()-exact."""
+    import http.client
+    import json as _json
+
+    from mmlspark_tpu.serving import read_stream
+
+    model, variables = lm
+    draft, dv = draft_lm
+    query = (read_stream()
+             .continuous_server(name="gen1spec", path="/lm")
+             .parse_request(schema=["prompt"])
+             .generate_stream(model, variables, max_new_tokens=6,
+                              max_slots=2, paged=True, page_size=8,
+                              draft_model=draft, draft_variables=dv,
+                              gamma=3)
+             .options(batch_timeout_ms=5.0)
+             .start())
+    try:
+        assert query._batcher.paged and query._batcher.draft_model is draft
+        conn = http.client.HTTPConnection(query.service_info.host,
+                                          query.service_info.port,
+                                          timeout=60)
+        conn.request("POST", "/lm", body=_json.dumps(
+            {"prompt": [3, 1, 4]}).encode())
+        got = [int(t) for t in conn.getresponse().read().decode().split()]
+        conn.close()
+    finally:
+        query.stop()
+    assert got == _reference(model, variables, [3, 1, 4], 6), got

@@ -2,7 +2,6 @@
 on CPU) must match the XLA gather composition exactly — including trash-
 page garbage, recycled pages, and per-slot positions mid-page."""
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
