@@ -179,22 +179,25 @@ class ContinuousBatcher:
         self._step = jax.jit(
             lambda v, t, c, p, pt: self.model.apply(
                 v, t, c, p, pt, method=self.model.decode_step))
-        # whole-slot overwrite: a newly admitted request's padded cache
-        # rows replace slot `i` across every layer in one jitted update
-        self._load = jax.jit(
-            lambda c, rows, i: jax.tree.map(
-                lambda dst, src: dst.at[i].set(src[0].astype(dst.dtype)),
-                c, rows))
-        # paged admit: prefill rows reshape into [MP, page, ...] blocks
-        # and scatter into the pools at this slot's page ids; blocks past
-        # the allocation carry the OUT-OF-RANGE id NP so mode="drop"
+        # whole-slot overwrite: admitted requests' padded cache rows
+        # replace their slots across every layer in one jitted update;
+        # pad rows carry the OUT-OF-RANGE slot id S so mode="drop"
         # discards them (NOT -1: jax wraps negative indices numpy-style
-        # BEFORE the bounds check, which would corrupt the last page)
-        self._load_paged = jax.jit(
+        # BEFORE the bounds check, which would corrupt the last slot)
+        self._load_many = jax.jit(
+            lambda c, rows, slots: jax.tree.map(
+                lambda dst, src: dst.at[slots].set(
+                    src.astype(dst.dtype), mode="drop"),
+                c, rows))
+        # paged admit: each row's prefill reshapes into [MP, page, ...]
+        # blocks and scatters into the pools at its page ids (flat
+        # [K*MP]); blocks past an allocation carry the out-of-range id
+        # NP and drop
+        self._load_paged_many = jax.jit(
             lambda c, rows, ids: jax.tree.map(
                 lambda pool, r: pool.at[ids].set(
-                    r[0].reshape(ids.shape[0], pool.shape[1],
-                                 *r.shape[2:]).astype(pool.dtype),
+                    r.reshape(ids.shape[0], pool.shape[1],
+                              *r.shape[2:]).astype(pool.dtype),
                     mode="drop"),
                 c, rows))
         if draft_model is not None:
@@ -324,55 +327,83 @@ class ContinuousBatcher:
             except Empty:
                 break
 
-    def _admit(self, slot: int, req: _Request):
-        from ..models.generation import _prefill_cache
-
-        # bucket prompt lengths to powers of two so admission compiles
-        # O(log max_len) prefill shapes total instead of one per distinct
-        # length (seconds-long XLA stalls in the serving hot path).  The
-        # padded tail is sound: causal masking keeps positions < n exact,
-        # and the garbage K/V rows >= n are never attendable — a decode
-        # step at pos p masks rows > p and overwrites row p itself first.
-        n = len(req.prompt)
+    def _bucket(self, n: int) -> int:
+        """Power-of-two prompt bucket so admission compiles O(log
+        max_len) prefill shapes total instead of one per distinct length
+        (seconds-long XLA stalls in the serving hot path).  The padded
+        tail is sound: causal masking keeps positions < n exact, and
+        the garbage K/V rows >= n are never attendable — a decode step
+        at pos p masks rows > p and overwrites row p itself first."""
         b = 16
         while b < n:
             b *= 2
         b = min(b, self.model.max_len)
         if self.draft_model is not None:
             b = min(b, self.draft_model.max_len)
-        padded = np.zeros(b, np.int32)
-        padded[:n] = req.prompt
-        logits, cache = _prefill_cache(self.model, self.variables,
-                                       jnp.asarray(padded[None]),
-                                       self.kv_cache_dtype)
-        if self.draft_model is not None:
-            # the draft's cache must hold the same prompt history; its
-            # prefill logits are unused — the first pending token is the
-            # TARGET's (exactness requires it)
-            _dlg, d_rows = _prefill_cache(self.draft_model,
-                                          self.draft_variables,
-                                          jnp.asarray(padded[None]))
-            self._d_cache = self._load(self._d_cache, d_rows, slot)
-        if self.paged:
-            # allocate this slot's prompt pages and scatter the prefill
-            # rows into them; bucketing garbage rows inside the last page
-            # are masked/overwritten exactly as in the dense layout
-            need = -(-n // self.page_size)
-            pages = [self._free.pop() for _ in range(need)]
-            self._slot_pages[slot] = pages
-            self._table[slot].fill(0)
-            self._table[slot, :need] = pages
-            ids = np.full(self._mp, self._np, np.int32)  # NP = dropped
-            ids[:need] = pages
-            self._cache = self._load_paged(self._cache, cache,
-                                           jnp.asarray(ids))
-        else:
-            self._cache = self._load(self._cache, cache, slot)
-        first = int(jnp.argmax(logits[0, n - 1]))
-        self._live[slot] = req
-        self._pos[slot] = len(req.prompt)
-        self._tok[slot] = first
-        self._emit(slot, first)
+        return b
+
+    def _admit_batch(self, batch):
+        """Admit several (slot, request) pairs with ONE prefill forward
+        per prompt bucket: a burst of arrivals costs one device program
+        instead of one per request.  Row counts pad to powers of two
+        (capped at max_slots) so each bucket compiles O(log max_slots)
+        batch shapes; pad rows compute garbage that the slot-indexed
+        loads drop (out-of-range sentinel + mode='drop')."""
+        from ..models.generation import _prefill_cache
+
+        by_bucket: dict = {}
+        for slot, req in batch:
+            by_bucket.setdefault(self._bucket(len(req.prompt)),
+                                 []).append((slot, req))
+        for b, group in sorted(by_bucket.items()):
+            k = len(group)
+            kp = 1
+            while kp < k:
+                kp *= 2
+            kp = min(kp, self.max_slots)
+            padded = np.zeros((kp, b), np.int32)
+            slots = np.full(kp, self.max_slots, np.int32)  # OOB = dropped
+            for i, (slot, req) in enumerate(group):
+                padded[i, :len(req.prompt)] = req.prompt
+                slots[i] = slot
+            logits, cache = _prefill_cache(self.model, self.variables,
+                                           jnp.asarray(padded),
+                                           self.kv_cache_dtype)
+            if self.draft_model is not None:
+                # the draft's cache must hold the same prompt history;
+                # its prefill logits are unused — the first pending token
+                # is the TARGET's (exactness requires it)
+                _dlg, d_rows = _prefill_cache(self.draft_model,
+                                              self.draft_variables,
+                                              jnp.asarray(padded))
+                self._d_cache = self._load_many(self._d_cache, d_rows,
+                                                jnp.asarray(slots))
+            if self.paged:
+                # allocate each slot's prompt pages and scatter all rows'
+                # prefill pages in one update; bucketing garbage inside
+                # the last page is masked/overwritten as in dense
+                ids = np.full((kp, self._mp), self._np, np.int32)
+                for i, (slot, req) in enumerate(group):
+                    need = -(-len(req.prompt) // self.page_size)
+                    pages = [self._free.pop() for _ in range(need)]
+                    self._slot_pages[slot] = pages
+                    self._table[slot].fill(0)
+                    self._table[slot, :need] = pages
+                    ids[i, :need] = pages
+                self._cache = self._load_paged_many(
+                    self._cache, cache, jnp.asarray(ids.reshape(-1)))
+            else:
+                self._cache = self._load_many(self._cache, cache,
+                                              jnp.asarray(slots))
+            firsts = np.asarray(jnp.argmax(logits[
+                jnp.arange(kp), jnp.asarray(
+                    [len(r.prompt) - 1 for _s, r in group]
+                    + [0] * (kp - k))], axis=-1), np.int32)
+            for i, (slot, req) in enumerate(group):
+                self._live[slot] = req
+                self._pos[slot] = len(req.prompt)
+                self._tok[slot] = int(firsts[i])
+                self._emit(slot, int(firsts[i]))
 
     def _emit(self, slot: int, tok: int):
         req = self._live[slot]
@@ -404,24 +435,28 @@ class ContinuousBatcher:
                 return
 
     def _try_admit(self):
-        """Admit from the FIFO head into free slots.  Paged mode admits
-        only while the head's worst-case page reservation fits the
-        unreserved budget — strict FIFO (no skipping), so a big request
-        can't be starved by a stream of small ones."""
+        """Admit from the FIFO head into free slots — collected into ONE
+        batched prefill (_admit_batch).  Paged mode admits only while
+        the head's worst-case page reservation fits the unreserved
+        budget — strict FIFO (no skipping), so a big request can't be
+        starved by a stream of small ones."""
+        batch = []
         for slot in range(self.max_slots):
             if not self._buffer:
-                return
+                break
             if self._live[slot] is not None:
                 continue
             req = self._buffer[0]
             if self.paged:
                 worst = self._worst_pages(len(req.prompt), req.max_new)
                 if worst > self._avail:
-                    return
+                    break
                 self._avail -= worst
                 self._slot_reserved[slot] = worst
             self._buffer.popleft()
-            self._admit(slot, req)
+            batch.append((slot, req))  # each slot index visited once
+        if batch:
+            self._admit_batch(batch)
 
     def _loop(self):
         while self._running.is_set():

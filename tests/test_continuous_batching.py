@@ -167,19 +167,28 @@ def test_int8_cache_slots_match_generate_int8(lm):
         ContinuousBatcher(model, variables, kv_cache_dtype="int4")
 
 
-def test_randomized_staggered_soak(lm):
+@pytest.mark.parametrize("mode", ["dense", "paged", "paged_spec"])
+def test_randomized_staggered_soak(lm, draft_lm, mode):
     # 12 requests, random lengths/budgets, submitted from threads at
     # random times into 3 slots — every stream must still be exactly
-    # generate()'s output (seeded: deterministic)
+    # generate()'s output (seeded: deterministic).  The paged and
+    # paged+speculative configs run the SAME chaos through page
+    # recycling / reservation deferral / per-slot block verification.
     import threading
     import time
 
     model, variables = lm
+    kw = {}
+    if mode != "dense":
+        kw.update(paged=True, page_size=8, num_pages=10)
+    if mode == "paged_spec":
+        draft, dv = draft_lm
+        kw.update(draft_model=draft, draft_variables=dv, gamma=3)
     rng = np.random.default_rng(42)
     jobs = [(rng.integers(0, 64, size=rng.integers(1, 9)).tolist(),
              int(rng.integers(2, 8))) for _ in range(12)]
     delays = rng.integers(0, 20, size=len(jobs))  # pre-drawn: Generator
-    batcher = ContinuousBatcher(model, variables, max_slots=3).start()
+    batcher = ContinuousBatcher(model, variables, max_slots=3, **kw).start()
     results = [None] * len(jobs)
 
     def submit(i):
