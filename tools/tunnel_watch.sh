@@ -1,8 +1,10 @@
 #!/bin/bash
-# Poll until the TPU backend answers, then run the full evidence sweep once
-# (tools/chip_session.sh).  The axon tunnel is transient: round 2 lost its
-# live capture to an outage, so the sweep must fire in whatever window
-# appears, unattended.
+# Poll until the TPU backend answers, then run the full evidence sweep
+# (tools/chip_session.sh).  The axon tunnel is transient: rounds 2 AND 3
+# lost their live captures to outages, so the sweep must fire in whatever
+# window appears, unattended — and if the tunnel dies MID-sweep before a
+# fresh benchmark record lands, go back to watching instead of exiting
+# with partial evidence.
 cd "$(dirname "$0")/.."
 echo "[tunnel_watch] $(date -u +%H:%M:%SZ) watching"
 while true; do
@@ -10,8 +12,15 @@ while true; do
       "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" \
       >/dev/null 2>&1; then
     echo "[tunnel_watch] $(date -u +%H:%M:%SZ) tunnel up; running sweep"
+    before=$(stat -c %Y BENCH_LASTGOOD.json 2>/dev/null || echo 0)
     bash tools/chip_session.sh
-    exit 0
+    after=$(stat -c %Y BENCH_LASTGOOD.json 2>/dev/null || echo 0)
+    if [ "$after" -gt "$before" ]; then
+      echo "[tunnel_watch] $(date -u +%H:%M:%SZ) fresh benchmark captured; done"
+      exit 0
+    fi
+    echo "[tunnel_watch] $(date -u +%H:%M:%SZ) sweep ran but no fresh" \
+         "benchmark landed (tunnel died mid-sweep?); resuming watch"
   fi
   echo "[tunnel_watch] $(date -u +%H:%M:%SZ) probe failed; retry in 120s"
   sleep 120
