@@ -259,9 +259,18 @@ class _Block(nn.Module):
                 # gather is XLA's — a Mosaic page-table kernel can replace
                 # it without touching this contract.
                 page = cache[0].shape[1]
-                pgmat = page_table[rows_mat, posmat // page]   # [B, s]
-                offmat = posmat % page
                 mp = page_table.shape[1]
+                # block positions past the table (bucket padding in a
+                # suffix prefill) must write to the TRASH page — the
+                # gather's default clamp would alias them onto the last
+                # REAL page and corrupt live rows
+                in_range = posmat < mp * page
+                pgmat = jnp.where(
+                    in_range,
+                    page_table[rows_mat,
+                               jnp.minimum(posmat // page, mp - 1)],
+                    0)                                         # [B, s]
+                offmat = posmat % page
                 if len(cache) == 4:
                     from ..ops.quant import quantize_kv_row
 

@@ -55,10 +55,11 @@ class TokenStream:
 
 class _Request:
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
-                 eos_id: Optional[int]):
-        self.prompt = prompt
+                 eos_id: Optional[int], prefix: Optional[int] = None):
+        self.prompt = prompt          # FULL ids (shared prefix + suffix)
         self.max_new = int(max_new_tokens)
         self.eos_id = eos_id
+        self.prefix = prefix          # register_prefix handle, or None
         self.stream = TokenStream()
         self.emitted = 0
 
@@ -146,7 +147,10 @@ class ContinuousBatcher:
             self._avail = len(self._free)           # unreserved budget
             self._slot_pages: List[List[int]] = [[] for _ in range(s)]
             self._slot_reserved = [0] * s
+            self._slot_shared = [0] * s   # leading SHARED-prefix pages
             self._table = np.zeros((s, self._mp), np.int32)
+            self._prefixes: dict = {}     # handle -> shared-prefix record
+            self._next_prefix = 1
         else:
             shape4, shape3 = (s, L, h, d), (s, L, h)
         if kv_cache_dtype == "int8":
@@ -166,6 +170,9 @@ class ContinuousBatcher:
         self._tok = np.zeros(s, np.int32)
         self._live: List[Optional[_Request]] = [None] * s
         self._pending: "Queue[_Request]" = Queue()
+        # control ops (prefix register/release) serviced by the loop
+        # thread, which owns the pool/free-list/device cache
+        self._ctl: Queue = Queue()
         # loop-thread-only FIFO between intake and admission: paged mode
         # may defer the queue head until enough pages free up
         self._buffer: "deque[_Request]" = deque()
@@ -220,20 +227,140 @@ class ContinuousBatcher:
                 lambda v, t, c, p: self.draft_model.apply(
                     v, t, c, p, None, method=self.draft_model.decode_step))
 
-    def _worst_pages(self, prompt_len: int, max_new: int) -> int:
+    def _worst_pages(self, prompt_len: int, max_new: int,
+                     shared_pages: int = 0) -> int:
         """Worst-case page count for one request — THE reservation
         invariant: submit()'s rejection and _try_admit()'s reservation
         must both use exactly this, or just-in-time growth in the loop
         can pop an empty free list mid-decode.  Speculative mode writes
         up to `gamma` rows past the emitted position per verify block,
-        so the reservation covers them too."""
+        so the reservation covers them too.  A shared prefix's leading
+        pages are the HANDLE's, not the request's."""
         return min(-(-(prompt_len + max_new + self.gamma)
-                     // self.page_size), self._mp)
+                     // self.page_size), self._mp) - shared_pages
+
+    # ---- shared-prefix caching (paged mode) ----------------------------
+    # The page pool, free list, and device cache are LOOP-THREAD-OWNED;
+    # prefix registration/release therefore route through a control queue
+    # the loop drains each tick (executed inline when the loop isn't
+    # running — the common register-at-setup case).
+
+    def _ctl_call(self, op, payload):
+        rec = {"op": op, "payload": payload, "event": threading.Event(),
+               "result": None, "error": None}
+        with self._submit_lock:
+            if self._stopped:
+                raise RuntimeError("ContinuousBatcher is stopped")
+            # inline only while no loop thread can possibly be running —
+            # a thread that is merely STOPPING may still be mid-tick,
+            # and the queue is drained (with errors) by stop() after the
+            # join, so enqueueing is always safe when it is alive
+            alive = self._thread is not None and self._thread.is_alive()
+            if alive:
+                self._ctl.put(rec)
+        if not alive:
+            return op(payload)
+        if not rec["event"].wait(timeout=300):
+            raise RuntimeError("batcher loop did not service the request")
+        if rec["error"] is not None:
+            raise rec["error"]
+        return rec["result"]
+
+    def register_prefix(self, prefix_ids) -> int:
+        """Prefill a shared prompt prefix (system prompt) ONCE into
+        dedicated read-only pages; `submit(..., prefix=handle)` requests
+        then reuse them — admission prefills only each request's suffix,
+        attending over the shared pages through its page table.  Only
+        the full pages share (floor(len/page) * page tokens); the
+        remainder rides with each request's suffix.  Write isolation is
+        structural: request writes start at the first non-shared
+        position, whose table entry is always a request-owned page.
+        Returns a handle for submit()/release_prefix()."""
+        if not self.paged:
+            raise ValueError("prefix caching needs paged=True")
+        ids = np.asarray(prefix_ids, np.int32).reshape(-1)
+        if len(ids) < 1:
+            raise ValueError("empty prefix")
+        if len(ids) + 1 + self.gamma > self.model.max_len:
+            raise ValueError("prefix leaves no room to generate")
+        return self._ctl_call(self._exec_register_prefix, ids)
+
+    def release_prefix(self, handle: int):
+        """Free a prefix's shared pages.  Refuses while any live or
+        pending request still uses it."""
+        return self._ctl_call(self._exec_release_prefix, int(handle))
+
+    def _exec_register_prefix(self, ids) -> int:
+        from ..models.generation import _prefill_cache
+
+        shared = len(ids) // self.page_size          # full pages only
+        if shared > self._avail:
+            raise ValueError(
+                f"prefix needs {shared} pages but only {self._avail} "
+                "are unreserved")
+        self._avail -= shared
+        pages = [self._free.pop() for _ in range(shared)]
+        try:
+            b = self._bucket(len(ids))
+            padded = np.zeros((1, b), np.int32)
+            padded[0, :len(ids)] = ids
+            logits, cache = _prefill_cache(self.model, self.variables,
+                                           jnp.asarray(padded),
+                                           self.kv_cache_dtype)
+            if shared:
+                page_ids = np.full(self._mp, self._np, np.int32)
+                page_ids[:shared] = pages
+                self._cache = self._load_paged_many(self._cache, cache,
+                                                    jnp.asarray(page_ids))
+        except Exception:
+            # a failed prefill must not leak the pool allocation
+            self._free.extend(pages)
+            self._avail += shared
+            raise
+        handle = self._next_prefix
+        self._next_prefix += 1
+        self._prefixes[handle] = {
+            "ids": ids, "pages": pages, "shared": shared,
+            # logits at the last prefix position: the first generated
+            # token when a request adds no suffix
+            "last_logits": np.asarray(logits[0, len(ids) - 1]),
+            "refs": 0,
+        }
+        return handle
+
+    def _exec_release_prefix(self, handle: int):
+        # the refs check + delete serialize against submit()'s refs
+        # increment (both under _submit_lock), so release can never slip
+        # between a submit's validation and its increment
+        with self._submit_lock:
+            rec = self._prefixes[handle]
+            if rec["refs"] > 0:
+                raise ValueError(f"prefix {handle} still has "
+                                 f"{rec['refs']} active request(s)")
+            del self._prefixes[handle]
+        self._free.extend(rec["pages"])
+        self._avail += rec["shared"]
 
     # ---- client side ---------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
-               eos_id: Optional[int] = None) -> TokenStream:
-        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+               eos_id: Optional[int] = None,
+               prefix: Optional[int] = None) -> TokenStream:
+        """`prefix`: a register_prefix handle — `prompt_ids` is then the
+        SUFFIX appended to the shared prefix (may be empty), and
+        admission prefills only the suffix."""
+        shared_pages = 0
+        if prefix is not None:
+            if not self.paged:
+                raise ValueError("prefix caching needs paged=True")
+            try:
+                rec = self._prefixes[prefix]
+            except KeyError:
+                raise ValueError(f"unknown or released prefix {prefix}")
+            prompt = np.concatenate(
+                [rec["ids"], np.asarray(prompt_ids, np.int32).reshape(-1)])
+            shared_pages = rec["shared"]
+        else:
+            prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
         limit = self.model.max_len - self.gamma
@@ -247,17 +374,22 @@ class ContinuousBatcher:
                 + (f" - gamma {self.gamma} (speculative lookahead)"
                    if self.gamma else ""))
         if self.paged:
-            worst = self._worst_pages(len(prompt), int(max_new_tokens))
-            if worst > self._np - 1:
+            worst = self._worst_pages(len(prompt), int(max_new_tokens),
+                                      shared_pages)
+            if worst > self._np - 1 - shared_pages:
                 raise ValueError(
                     f"request needs up to {worst} pages but the pool has "
                     f"{self._np - 1}; raise num_pages")
-        req = _Request(prompt, max_new_tokens, eos_id)
+        req = _Request(prompt, max_new_tokens, eos_id, prefix=prefix)
         with self._submit_lock:
             if self._stopped:
                 # a late submit racing stop() would otherwise wait forever
                 # on a stream nobody will ever close
                 raise RuntimeError("ContinuousBatcher is stopped")
+            if prefix is not None:
+                if prefix not in self._prefixes:  # released since lookup
+                    raise ValueError(f"prefix {prefix} was released")
+                self._prefixes[prefix]["refs"] += 1
             self._pending.put(req)
         return req.stream
 
@@ -326,6 +458,13 @@ class ContinuousBatcher:
                 self._pending.get_nowait().stream._q.put(None)
             except Empty:
                 break
+        while True:  # unblock any caller waiting on a control op
+            try:
+                rec = self._ctl.get_nowait()
+            except Empty:
+                break
+            rec["error"] = RuntimeError("ContinuousBatcher is stopped")
+            rec["event"].set()
 
     def _bucket(self, n: int) -> int:
         """Power-of-two prompt bucket so admission compiles O(log
@@ -352,9 +491,18 @@ class ContinuousBatcher:
         from ..models.generation import _prefill_cache
 
         by_bucket: dict = {}
+        prefix_groups: dict = {}
         for slot, req in batch:
-            by_bucket.setdefault(self._bucket(len(req.prompt)),
-                                 []).append((slot, req))
+            if req.prefix is not None:
+                rec = self._prefixes[req.prefix]
+                rest = len(req.prompt) - rec["shared"] * self.page_size
+                rb = self._bucket(max(rest, 1)) if rest else 0
+                prefix_groups.setdefault(rb, []).append((slot, req))
+            else:
+                by_bucket.setdefault(self._bucket(len(req.prompt)),
+                                     []).append((slot, req))
+        if prefix_groups:
+            self._admit_prefix_groups(prefix_groups)
         for b, group in sorted(by_bucket.items()):
             k = len(group)
             kp = 1
@@ -387,6 +535,7 @@ class ContinuousBatcher:
                     need = -(-len(req.prompt) // self.page_size)
                     pages = [self._free.pop() for _ in range(need)]
                     self._slot_pages[slot] = pages
+                    self._slot_shared[slot] = 0
                     self._table[slot].fill(0)
                     self._table[slot, :need] = pages
                     ids[i, :need] = pages
@@ -400,6 +549,90 @@ class ContinuousBatcher:
                     [len(r.prompt) - 1 for _s, r in group]
                     + [0] * (kp - k))], axis=-1), np.int32)
             for i, (slot, req) in enumerate(group):
+                self._live[slot] = req
+                self._pos[slot] = len(req.prompt)
+                self._tok[slot] = int(firsts[i])
+                self._emit(slot, int(firsts[i]))
+
+    def _admit_prefix_groups(self, prefix_groups):
+        """Admit shared-prefix requests: wire each slot's page table to
+        the prefix's read-only pages + freshly allocated own pages, then
+        prefill ONLY the suffix via one slot-BLOCK decode per rest
+        bucket (the block attends the shared rows through the table —
+        exactly the full prefill's math for those positions).  rest=0
+        requests skip the forward entirely: their first token comes from
+        the logits the prefix registration stored."""
+        from ..models.generation import _prefill_cache
+
+        if self.draft_model is not None:
+            # the dense draft cache cannot share pages — prefill the FULL
+            # prompts, batched per bucket like _admit_batch (the draft is
+            # the cheap model; the TARGET's prefix reuse is the win)
+            by_draft_bucket: dict = {}
+            for group in prefix_groups.values():
+                for slot, req in group:
+                    by_draft_bucket.setdefault(
+                        self._bucket(len(req.prompt)), []).append((slot, req))
+            for db, dgroup in sorted(by_draft_bucket.items()):
+                dk = len(dgroup)
+                dkp = 1
+                while dkp < dk:
+                    dkp *= 2
+                dkp = min(dkp, self.max_slots)
+                dpad = np.zeros((dkp, db), np.int32)
+                dslots = np.full(dkp, self.max_slots, np.int32)
+                for i, (slot, req) in enumerate(dgroup):
+                    dpad[i, :len(req.prompt)] = req.prompt
+                    dslots[i] = slot
+                _dl, d_rows = _prefill_cache(self.draft_model,
+                                             self.draft_variables,
+                                             jnp.asarray(dpad))
+                self._d_cache = self._load_many(self._d_cache, d_rows,
+                                                jnp.asarray(dslots))
+        for rb, group in sorted(prefix_groups.items()):
+            fill = []                  # rows that need a suffix forward
+            for slot, req in group:
+                rec = self._prefixes[req.prefix]
+                shared = rec["shared"]
+                shared_tokens = shared * self.page_size
+                n = len(req.prompt)
+                need = -(-n // self.page_size) - shared
+                pages = [self._free.pop() for _ in range(need)]
+                self._slot_pages[slot] = pages
+                self._slot_shared[slot] = shared
+                self._table[slot].fill(0)
+                self._table[slot, :shared] = rec["pages"]
+                self._table[slot, shared:shared + need] = pages
+                if n > shared_tokens:
+                    fill.append((slot, req, shared_tokens))
+                else:
+                    first = int(np.argmax(rec["last_logits"]))
+                    self._live[slot] = req
+                    self._pos[slot] = n
+                    self._tok[slot] = first
+                    self._emit(slot, first)
+            if not fill:
+                continue
+            k = len(fill)
+            kp = 1
+            while kp < k:
+                kp *= 2
+            kp = min(kp, self.max_slots)
+            toks = np.zeros((kp, rb), np.int32)
+            pos = np.zeros(kp, np.int32)
+            tables = np.zeros((kp, self._mp), np.int32)
+            for i, (slot, req, st) in enumerate(fill):
+                toks[i, :len(req.prompt) - st] = req.prompt[st:]
+                pos[i] = st
+                tables[i] = self._table[slot]
+            logits, self._cache = self._step(
+                self.variables, jnp.asarray(toks), self._cache,
+                jnp.asarray(pos), jnp.asarray(tables))
+            firsts = np.asarray(jnp.argmax(logits[
+                jnp.arange(kp), jnp.asarray(
+                    [len(r.prompt) - st - 1 for _s, r, st in fill]
+                    + [0] * (kp - k))], axis=-1), np.int32)
+            for i, (slot, req, _st) in enumerate(fill):
                 self._live[slot] = req
                 self._pos[slot] = len(req.prompt)
                 self._tok[slot] = int(firsts[i])
@@ -420,14 +653,28 @@ class ContinuousBatcher:
             # lookahead (pos + gamma) could push past the cache bound
             self._pos[slot] = 0
             self._tok[slot] = 0
-            if self.paged:  # return pages + release the reservation
+            if self.paged:  # return OWNED pages + release the reservation
                 self._free.extend(self._slot_pages[slot])
                 self._slot_pages[slot] = []
+                self._slot_shared[slot] = 0
                 self._table[slot].fill(0)
                 self._avail += self._slot_reserved[slot]
                 self._slot_reserved[slot] = 0
+                if req.prefix is not None:
+                    with self._submit_lock:
+                        self._prefixes[req.prefix]["refs"] -= 1
 
     def _drain_intake(self):
+        while True:  # control ops first: admissions may depend on them
+            try:
+                rec = self._ctl.get_nowait()
+            except Empty:
+                break
+            try:
+                rec["result"] = rec["op"](rec["payload"])
+            except Exception as e:  # noqa: BLE001 — surfaced to the caller
+                rec["error"] = e
+            rec["event"].set()
         while True:
             try:
                 self._buffer.append(self._pending.get_nowait())
@@ -448,7 +695,10 @@ class ContinuousBatcher:
                 continue
             req = self._buffer[0]
             if self.paged:
-                worst = self._worst_pages(len(req.prompt), req.max_new)
+                shared = (self._prefixes[req.prefix]["shared"]
+                          if req.prefix is not None else 0)
+                worst = self._worst_pages(len(req.prompt), req.max_new,
+                                          shared)
                 if worst > self._avail:
                     break
                 self._avail -= worst
@@ -481,9 +731,11 @@ class ContinuousBatcher:
                 # free list can cover it)
                 for sl in active:
                     idx = (int(self._pos[sl]) + self.gamma) // self.page_size
-                    while idx >= len(self._slot_pages[sl]):
+                    while idx >= (self._slot_shared[sl]
+                                  + len(self._slot_pages[sl])):
                         pg = self._free.pop()
-                        self._table[sl, len(self._slot_pages[sl])] = pg
+                        self._table[sl, self._slot_shared[sl]
+                                    + len(self._slot_pages[sl])] = pg
                         self._slot_pages[sl].append(pg)
             if self.draft_model is not None:
                 self._speculative_tick(active)
