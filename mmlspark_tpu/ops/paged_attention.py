@@ -28,8 +28,13 @@ conservative shape/VMEM gate (`paged_kernel_ok`) keeps ineligible
 configs — GQA pools, odd head dims, oversized pages — on the XLA
 composition.  If a gated-in shape still trips Mosaic on real hardware
 (the gate is an estimate), the failure surfaces at the serving step's
-first compile; `MMLSPARK_NO_PAGED_KERNEL=1` is the operational
-kill-switch that forces the gather path without a code change.
+first compile; `MMLSPARK_NO_PAGED_KERNEL=1` forces the gather path
+without a code change.  Scope of that switch: the env var is read at
+TRACE time, so it must be set BEFORE the serving process compiles its
+first paged step — flipping it in an already-running server does
+nothing for programs XLA has already compiled (restart the process, or
+clear the jit caches with `jax.clear_caches()` and let the next step
+retrace).
 """
 from __future__ import annotations
 

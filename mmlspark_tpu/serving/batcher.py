@@ -36,15 +36,22 @@ __all__ = ["ContinuousBatcher", "TokenStream"]
 
 class TokenStream:
     """Iterator over one request's generated token ids (host ints).
-    Blocks until tokens arrive; ends when the request finishes."""
+    Blocks until tokens arrive; ends when the request finishes.  A
+    request the scheduler had to abandon (e.g. its paged reservation
+    can never fit after a later prefix registration shrank the pool)
+    closes the stream with `error` set and iteration raises it —
+    consumers must never block forever on a request that cannot run."""
 
     def __init__(self):
         self._q: "Queue[Optional[int]]" = Queue()
+        self.error: Optional[Exception] = None
 
     def __iter__(self) -> Iterator[int]:
         while True:
             tok = self._q.get()
             if tok is None:
+                if self.error is not None:
+                    raise self.error
                 return
             yield tok
 
@@ -180,8 +187,10 @@ class ContinuousBatcher:
         self._stopped = False
         # serializes the stopped-check+enqueue in submit() against stop()'s
         # drain: without it a submit racing stop can enqueue after the
-        # drain, leaving a stream whose consumer blocks forever
-        self._submit_lock = threading.Lock()
+        # drain, leaving a stream whose consumer blocks forever.  RLock:
+        # _ctl_call executes control ops INLINE under this lock when no
+        # loop thread runs, and _exec_release_prefix re-acquires it
+        self._submit_lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._step = jax.jit(
             lambda v, t, c, p, pt: self.model.apply(
@@ -227,6 +236,16 @@ class ContinuousBatcher:
                 lambda v, t, c, p: self.draft_model.apply(
                     v, t, c, p, None, method=self.draft_model.decode_step))
 
+    def _page_ceiling(self) -> int:
+        """Pages that can EVER be simultaneously free for one request:
+        the pool minus every registered prefix's held pages.  submit()'s
+        reject and _try_admit()'s drop are the two ends of the same
+        admission invariant and MUST share this expression — divergence
+        would let submit accept a request the scheduler then errors (or
+        silently wedge valid ones)."""
+        return self._np - 1 - sum(
+            r["shared"] for r in self._prefixes.values())
+
     def _worst_pages(self, prompt_len: int, max_new: int,
                      shared_pages: int = 0) -> int:
         """Worst-case page count for one request — THE reservation
@@ -254,12 +273,15 @@ class ContinuousBatcher:
             # inline only while no loop thread can possibly be running —
             # a thread that is merely STOPPING may still be mid-tick,
             # and the queue is drained (with errors) by stop() after the
-            # join, so enqueueing is always safe when it is alive
+            # join, so enqueueing is always safe when it is alive.  The
+            # inline execution stays UNDER the lock: start() also takes
+            # it, so a racing start() cannot spawn a ticking loop while
+            # the caller thread mutates the loop-owned pool state.
             alive = self._thread is not None and self._thread.is_alive()
             if alive:
                 self._ctl.put(rec)
-        if not alive:
-            return op(payload)
+            else:
+                return op(payload)
         if not rec["event"].wait(timeout=300):
             raise RuntimeError("batcher loop did not service the request")
         if rec["error"] is not None:
@@ -283,6 +305,17 @@ class ContinuousBatcher:
             raise ValueError("empty prefix")
         if len(ids) + 1 + self.gamma > self.model.max_len:
             raise ValueError("prefix leaves no room to generate")
+        if (self.draft_model is not None
+                and len(ids) + 1 + self.gamma > self.draft_model.max_len):
+            # mirror submit()'s limit: the dense draft cache must hold the
+            # FULL prompt (prefix + suffix), and _bucket caps prefill
+            # widths at the draft's max_len — without this check a long
+            # prefix dies later in an opaque broadcast error
+            raise ValueError(
+                f"prefix of {len(ids)} tokens exceeds the draft model's "
+                f"max_len {self.draft_model.max_len} - 1 - gamma "
+                f"{self.gamma} (speculative mode prefills the full "
+                "prompt into the draft cache)")
         return self._ctl_call(self._exec_register_prefix, ids)
 
     def release_prefix(self, handle: int):
@@ -373,15 +406,23 @@ class ContinuousBatcher:
                 f"max_len {self.model.max_len}"
                 + (f" - gamma {self.gamma} (speculative lookahead)"
                    if self.gamma else ""))
-        if self.paged:
-            worst = self._worst_pages(len(prompt), int(max_new_tokens),
-                                      shared_pages)
-            if worst > self._np - 1 - shared_pages:
-                raise ValueError(
-                    f"request needs up to {worst} pages but the pool has "
-                    f"{self._np - 1}; raise num_pages")
         req = _Request(prompt, max_new_tokens, eos_id, prefix=prefix)
         with self._submit_lock:
+            if self.paged:
+                worst = self._worst_pages(len(prompt), int(max_new_tokens),
+                                          shared_pages)
+                # own prefix included in the ceiling — _worst_pages
+                # already credits the own prefix's shared count; pages
+                # held by other prefixes never return to _avail, so a
+                # request that only fits without them would sit at the
+                # FIFO head forever, wedging everyone behind it
+                ceiling = self._page_ceiling()
+                if worst > ceiling:
+                    raise ValueError(
+                        f"request needs up to {worst} pages but only "
+                        f"{ceiling} of the pool's {self._np - 1} can ever "
+                        "free up (registered prefixes hold the rest); "
+                        "raise num_pages or release prefixes")
             if self._stopped:
                 # a late submit racing stop() would otherwise wait forever
                 # on a stream nobody will ever close
@@ -420,10 +461,14 @@ class ContinuousBatcher:
 
     # ---- scheduler loop ------------------------------------------------
     def start(self) -> "ContinuousBatcher":
-        self._running.set()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="continuous-batcher")
-        self._thread.start()
+        # under _submit_lock: _ctl_call's inline path decides "no loop
+        # thread is running" and mutates pool state under this lock — the
+        # spawn must not interleave with that decision
+        with self._submit_lock:
+            self._running.set()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="continuous-batcher")
+            self._thread.start()
         return self
 
     def stop(self):
@@ -687,6 +732,28 @@ class ContinuousBatcher:
         the head's worst-case page reservation fits the unreserved
         budget — strict FIFO (no skipping), so a big request can't be
         starved by a stream of small ones."""
+        if self.paged:
+            # fail-fast pre-pass: a prefix registered AFTER a request
+            # passed submit()'s ceiling check can shrink the achievable
+            # budget below its reservation — a head that can NEVER fit
+            # must error its stream, not wedge the FIFO forever
+            ceiling = self._page_ceiling()
+            while self._buffer:
+                head = self._buffer[0]
+                shared = (self._prefixes[head.prefix]["shared"]
+                          if head.prefix is not None else 0)
+                if self._worst_pages(len(head.prompt), head.max_new,
+                                     shared) <= ceiling:
+                    break
+                self._buffer.popleft()
+                if head.prefix is not None:
+                    with self._submit_lock:
+                        self._prefixes[head.prefix]["refs"] -= 1
+                head.stream.error = RuntimeError(
+                    "request dropped: its worst-case page reservation "
+                    f"exceeds the {ceiling} pages that can ever free up "
+                    "(prefixes registered after submit hold the rest)")
+                head.stream._q.put(None)
         batch = []
         for slot in range(self.max_slots):
             if not self._buffer:

@@ -6,12 +6,20 @@ Must run before jax import.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: axon preset would grab the real chip
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# MMLSPARK_TEST_ON_TPU=1 (set only by tools/chip_session.sh's tpu-tests
+# stage) leaves the real backend in place so the two real-hardware
+# Mosaic skips can actually clear; the default pins the virtual CPU mesh
+# — without the opt-in the skipif gates could NEVER pass and the chip
+# session would burn tunnel time running everything on CPU.
+_ON_TPU = os.environ.get("MMLSPARK_TEST_ON_TPU") == "1"
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # force: axon preset would grab the real chip
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -21,8 +29,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # long as no devices have been created yet.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
+else:
+    # fail fast, not silently-on-CPU: if the tunnel died between the
+    # watcher's probe and this stage, every Mosaic gate would quietly
+    # re-skip while burning the stage timeout
+    assert jax.default_backend() == "tpu", (
+        "MMLSPARK_TEST_ON_TPU=1 but backend is "
+        f"{jax.default_backend()!r} — tunnel down?")
 
 import numpy as np
 import pytest

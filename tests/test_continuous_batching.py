@@ -699,3 +699,70 @@ def test_prefix_page_aligned_empty_suffix(lm):
         batcher.stop()
     assert toks == _reference(model, variables, prefix, 6)
     assert toks2 == _reference(model, variables, p3 + long_sfx, 6)
+
+
+def test_submit_ceiling_counts_all_prefixes(lm):
+    """ADVICE r4 (medium): submit()'s capacity check must count pages
+    held by EVERY registered prefix, not only the request's own — a
+    request that passes a pool-wide check but can never satisfy the
+    achievable budget would wedge the FIFO head forever."""
+    model, variables = lm
+    # pool: 5 usable pages (page 0 is trash); prefix holds 1
+    batcher = ContinuousBatcher(model, variables, max_slots=1, paged=True,
+                                page_size=8, num_pages=6)
+    h = batcher.register_prefix(list(range(1, 10)))      # 1 shared page
+    # worst = ceil((20+20)/8) = 5 own pages > 4 achievable (5 - 1 held)
+    with pytest.raises(ValueError, match="can ever free"):
+        batcher.submit([1] * 20, max_new_tokens=20)
+    # with the prefix released the same request is admissible again
+    batcher.release_prefix(h)
+    st = batcher.submit([1] * 20, max_new_tokens=2)
+    batcher.start()
+    try:
+        assert st.tokens() == _reference(model, variables, [1] * 20, 2)
+    finally:
+        batcher.stop()
+
+
+def test_late_prefix_fails_neverfit_head_instead_of_wedging(lm):
+    """ADVICE r4 (medium): a prefix registered AFTER a request passed
+    submit()'s ceiling check can shrink the achievable budget below the
+    request's reservation — the scheduler must fail that stream with an
+    error, not defer it (and everyone behind it) forever."""
+    model, variables = lm
+    batcher = ContinuousBatcher(model, variables, max_slots=1, paged=True,
+                                page_size=8, num_pages=6)
+    # passes: worst 5 == achievable 5 (no prefixes yet); loop not started,
+    # so the request sits in _pending
+    doomed = batcher.submit([1] * 20, max_new_tokens=20)
+    # inline registration (no loop yet) takes a page: achievable drops to 4
+    batcher.register_prefix(list(range(1, 10)))
+    behind = None
+    batcher.start()
+    try:
+        with pytest.raises(RuntimeError, match="can ever free"):
+            doomed.tokens()
+        # the queue behind the failed head must still drain normally
+        behind = batcher.submit([2] * 4, max_new_tokens=3).tokens()
+    finally:
+        batcher.stop()
+    assert behind == _reference(model, variables, [2] * 4, 3)
+
+
+def test_register_prefix_validates_draft_max_len(lm):
+    """ADVICE r4 (low): a prefix longer than the DRAFT's max_len must
+    fail register_prefix with a clear error (speculative mode prefills
+    the full prompt into the dense draft cache), not die later in a
+    numpy broadcast."""
+    model, variables = lm
+    draft = transformer_lm(vocab_size=model.vocab_size, embed_dim=16,
+                           num_layers=1, num_heads=2, max_len=16,
+                           dtype=jnp.float32)
+    dv = draft.init({"params": jax.random.PRNGKey(3)},
+                    jnp.zeros((1, 4), jnp.int32), train=False)
+    dv = {c: v for c, v in dv.items() if c != "kvcache"}
+    batcher = ContinuousBatcher(model, variables, max_slots=1, paged=True,
+                                page_size=8, draft_model=draft,
+                                draft_variables=dv, gamma=4)
+    with pytest.raises(ValueError, match="draft"):
+        batcher.register_prefix(list(range(1, 13)))      # 12+1+4 > 16

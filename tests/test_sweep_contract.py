@@ -1,0 +1,149 @@
+"""Chip-session de-risk: every mfu_sweep mode and the chip_session.sh
+stage list must survive a CPU dry-run BEFORE the scarce tunnel window
+opens.  bench.py has this discipline (tests/test_bench_contract.py); this
+module extends it to the sweep harness — a typo or API drift in any sweep
+mode would otherwise burn the first (possibly only, possibly short)
+tunnel-up window discovering it.  Reference analogue: the harness tests
+its own benchmark driver (Benchmarks.scala:36-80).
+
+All five modes run CONCURRENTLY as subprocesses with the committed smoke
+envs (MFU_SWEEP_SMOKE / ATTN_SWEEP_POINTS / DECODE_SWEEP_SMALL /
+SERVING_SWEEP_SMALL), so wall time is bounded by the slowest mode, not
+the sum."""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SWEEP = os.path.join(REPO, "tools", "mfu_sweep.py")
+SESSION = os.path.join(REPO, "tools", "chip_session.sh")
+
+MODES = {
+    # mode-flag -> (extra env, min JSON lines expected on stdout)
+    "--quick": ({"MFU_SWEEP_SMOKE": "1"}, 6),
+    "--attn": ({"ATTN_SWEEP_POINTS": "128:64:2"}, 1),
+    "--decode": ({"MFU_SWEEP_SMOKE": "1", "DECODE_SWEEP_SMALL": "1"}, 1),
+    "--batcher": ({"DECODE_SWEEP_SMALL": "1"}, 1),
+    "--serving": ({"SERVING_SWEEP_SMALL": "1"}, 1),
+}
+
+
+@pytest.fixture(scope="module")
+def sweep_runs():
+    """Launch every sweep mode concurrently; map mode -> (rc, stdout, stderr)."""
+    procs = {}
+    for flag, (env_extra, _n) in MODES.items():
+        env = dict(os.environ, **env_extra)
+        procs[flag] = subprocess.Popen(
+            [sys.executable, SWEEP, flag], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO)
+    out = {}
+    for flag, p in procs.items():
+        try:
+            # generous: 5 concurrent JAX processes (one spawning 6 serial
+            # cold-start children) contend for one core on the CI host
+            stdout, stderr = p.communicate(timeout=1500)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            stdout, stderr = p.communicate()
+            out[flag] = (-1, stdout, "TIMEOUT\n" + stderr[-2000:])
+            continue
+        out[flag] = (p.returncode, stdout, stderr)
+    return out
+
+
+def _json_lines(stdout: str):
+    recs = []
+    for line in stdout.strip().splitlines():
+        recs.append(json.loads(line))  # every stdout line must be JSON
+    return recs
+
+
+@pytest.mark.parametrize("flag", list(MODES))
+def test_mode_emits_parseable_json(sweep_runs, flag):
+    rc, stdout, stderr = sweep_runs[flag]
+    assert rc == 0, f"{flag} exited {rc}: {stderr[-2000:]}"
+    recs = _json_lines(stdout)
+    assert len(recs) >= MODES[flag][1], (flag, stdout)
+    for rec in recs:
+        assert "error" not in rec, (flag, rec)
+
+
+def test_quick_covers_every_config(sweep_runs):
+    rc, stdout, _ = sweep_runs["--quick"]
+    assert rc == 0
+    tags = {r["tag"] for r in _json_lines(stdout)}
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("mfu_sweep_ut", SWEEP)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert tags == mod.QUICK, f"sweep ran {tags}, config table says {mod.QUICK}"
+    for rec in _json_lines(stdout):
+        assert rec["ips"] > 0 and rec["xla_flops"] > 0
+
+
+def test_attn_parity_enforced(sweep_runs):
+    _, stdout, _ = sweep_runs["--attn"]
+    for rec in _json_lines(stdout):
+        assert rec["parity_ok"] is True
+        # CPU runs the interpret path; 'mosaic_validated' may only be set
+        # on a real chip — asserting False here guards against the flag
+        # lying when no TPU is present
+        assert rec["mosaic_validated"] is False
+        assert rec["pallas_path"] in ("interpret", "xla-fallback")
+
+
+def test_decode_reports_all_variants(sweep_runs):
+    (rec,) = _json_lines(sweep_runs["--decode"][1])
+    for tag in ("f32", "int8", "int8_kv8", "gqa4"):
+        assert rec[f"decode_tok_per_sec_{tag}"] > 0
+    assert rec["paged_kernel_parity_ok"] is True
+    assert rec["paged_kernel_validated"] is False  # no chip in CI
+
+
+def test_batcher_reports_ratios(sweep_runs):
+    (rec,) = _json_lines(sweep_runs["--batcher"][1])
+    for key in ("batching_speedup", "paged_throughput_ratio",
+                "spec_throughput_ratio", "paged_hbm_ratio"):
+        assert rec[key] > 0, (key, rec)
+
+
+def test_serving_reports_latency(sweep_runs):
+    (rec,) = _json_lines(sweep_runs["--serving"][1])
+    assert rec["serving_chip_p50_ms"] > 0
+    assert rec["serving_chip_qps"] > 0
+    assert rec["requests"] >= 8  # warm-up + both clients' requests landed
+
+
+def test_chip_session_stage_list_dryrun():
+    """CHIP_SESSION_DRYRUN prints every stage command; validate each one
+    references real files and real mfu_sweep flags without chip time."""
+    proc = subprocess.run(
+        ["bash", SESSION], env=dict(os.environ, CHIP_SESSION_DRYRUN="1"),
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    cmds = [l[len("DRYRUN: "):] for l in proc.stdout.splitlines()
+            if l.startswith("DRYRUN: ")]
+    stages = [l.split()[1] for l in proc.stdout.splitlines()
+              if l.startswith("== ") and "->" in l]
+    assert stages == ["bench", "attn-sweep", "mfu-sweep", "decode-sweep",
+                      "batcher-sweep", "serving-sweep", "tpu-tests"]
+    help_text = subprocess.run(
+        [sys.executable, SWEEP, "--help"], capture_output=True, text=True,
+        timeout=60, cwd=REPO).stdout
+    for cmd in cmds:
+        toks = cmd.split()
+        assert toks[0] == "timeout" and toks[1].isdigit(), cmd
+        # every referenced repo file must exist
+        for t in toks:
+            if t.endswith((".py", ".sh")):
+                assert os.path.exists(os.path.join(REPO, t)), (cmd, t)
+        # every mfu_sweep flag must be a real argparse option
+        if "mfu_sweep.py" in cmd:
+            for flag in re.findall(r"--[\w-]+", cmd):
+                assert flag in help_text, (cmd, flag)

@@ -17,31 +17,47 @@ cd "$(dirname "$0")/.."
 mkdir -p tools/chip_logs
 ts=$(date -u +%Y%m%dT%H%M%SZ)
 log() { echo "== $1 -> tools/chip_logs/${ts}-$1.log"; }
+# CHIP_SESSION_DRYRUN=1: print each stage command instead of executing —
+# tests/test_sweep_contract.py validates the stage list (files exist, flags
+# parse) without chip time, so a typo can't burn the first tunnel window
+run() {
+  local name=$1; shift
+  log "$name"
+  if [ "${CHIP_SESSION_DRYRUN:-}" = "1" ]; then
+    echo "DRYRUN: $*"
+  else
+    # strip the CPU-smoke knobs AND the CPU platform pin: a leaked
+    # MFU_SWEEP_SMOKE would make a real chip session silently measure the
+    # tiny smoke siblings, and a leaked JAX_PLATFORMS=cpu would run the
+    # whole window on the host CPU with device="cpu" records
+    env -u MFU_SWEEP_SMOKE -u DECODE_SWEEP_SMALL -u SERVING_SWEEP_SMALL \
+        -u ATTN_SWEEP_POINTS -u JAX_PLATFORMS \
+        "$@" 2>&1 | tee "tools/chip_logs/${ts}-${name}.log"
+  fi
+}
 
-log bench
 # margin: up to 720s of backend probes + the 2400s child watchdog must both
 # fit, or the stale-fallback JSON the watchdog exists to print is lost
-timeout 3300 python bench.py 2>&1 | tee "tools/chip_logs/${ts}-bench.log"
+run bench timeout 3300 python bench.py
 
-log attn-sweep
-timeout 1800 python tools/mfu_sweep.py --attn 2>&1 | tee "tools/chip_logs/${ts}-attn-sweep.log"
+run attn-sweep timeout 1800 python tools/mfu_sweep.py --attn
 
-log mfu-sweep
 # 6 quick configs (resnet50 b128/256/512 + vit b128/256 + vit-int8) x 900s cap
-timeout 6300 python tools/mfu_sweep.py --quick 2>&1 | tee "tools/chip_logs/${ts}-mfu-sweep.log"
+run mfu-sweep timeout 6300 python tools/mfu_sweep.py --quick
 
-log decode-sweep
-timeout 1800 python tools/mfu_sweep.py --decode 2>&1 | tee "tools/chip_logs/${ts}-decode-sweep.log"
+run decode-sweep timeout 1800 python tools/mfu_sweep.py --decode
 
-log batcher-sweep
-timeout 1800 python tools/mfu_sweep.py --batcher 2>&1 | tee "tools/chip_logs/${ts}-batcher-sweep.log"
+run batcher-sweep timeout 1800 python tools/mfu_sweep.py --batcher
 
-log serving-sweep
-timeout 1800 python tools/mfu_sweep.py --serving 2>&1 | tee "tools/chip_logs/${ts}-serving-sweep.log"
+run serving-sweep timeout 1800 python tools/mfu_sweep.py --serving
 
-log tpu-tests
-timeout 1800 python -m pytest tests/test_image_ops.py tests/test_attention_kernels.py \
-    tests/test_paged_attention.py -q \
-    2>&1 | tee "tools/chip_logs/${ts}-tpu-tests.log"
+# MMLSPARK_TEST_ON_TPU=1: conftest leaves the real backend in place so the
+# two Mosaic hardware skips can clear (default pins the CPU mesh).  The
+# "sharded" image tests hard-require the 8-device virtual mesh — exclude
+# them on the (possibly 1-chip) real backend; everything else in these
+# files is single-device and runs under real Mosaic.
+run tpu-tests timeout 1800 env MMLSPARK_TEST_ON_TPU=1 python -m pytest \
+    tests/test_image_ops.py tests/test_attention_kernels.py \
+    tests/test_paged_attention.py -q -k "not sharded"
 
 echo "== chip session ${ts} complete; commit tools/chip_logs/ + BENCH_LASTGOOD.json"

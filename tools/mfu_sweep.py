@@ -74,7 +74,17 @@ def child(batch: int, builder: str = "resnet50") -> int:
     if builder.endswith("_int8"):
         base = builder[: -len("_int8")]
         kwargs["quant"] = True
-    bundle = FlaxBundle(base, kwargs, input_shape=(224, 224, 3))
+    side, iters = 224, 10
+    smoke = bool(os.environ.get("MFU_SWEEP_SMOKE"))
+    if smoke:
+        # CPU contract smoke (tests/test_sweep_contract.py): same code path
+        # — FlaxBundle, quant branch, cost_analysis, timing, JSON shape —
+        # on a sibling backbone tiny enough for the CPU backend; batches
+        # stay distinct (128/256/512 -> 1/2/4) so the sweep loop is still
+        # a real batch sweep, not three duplicate children
+        base = {"resnet50": "resnet18", "vit_base": "vit_tiny"}.get(base, base)
+        batch, side, iters = max(1, batch // 128), 32, 1
+    bundle = FlaxBundle(base, kwargs, input_shape=(side, side, 3))
     if kwargs.get("quant"):
         # the int8 path's deployment contract is the UNCHANGED f32 pytree
         # (ops/quant.py) — casting to bf16 here would halve weight reads
@@ -88,15 +98,19 @@ def child(batch: int, builder: str = "resnet50") -> int:
         return bundle.apply(v, x)["pool"]
 
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16)
+    x = jnp.asarray(rng.normal(size=(batch, side, side, 3)), jnp.bfloat16)
     compiled = jax.jit(forward).lower(dev_vars, x).compile()
     cost = compiled.cost_analysis()
     flops = float(cost.get("flops", 0.0))
     bytes_acc = float(cost.get("bytes accessed", 0.0))
-    ms = _bench_ms(compiled, dev_vars, x, iters=10)
+    ms = _bench_ms(compiled, dev_vars, x, iters=iters)
     kind = jax.devices()[0].device_kind
     peak = _chip_peak_flops()
     print(json.dumps({
+        # a smoke record must be self-identifying: it measured the tiny
+        # sibling (resnet18/vit_tiny @ 32px), not the labeled builder
+        **({"smoke": True, "smoke_builder": base, "smoke_side": side}
+           if smoke else {}),
         "builder": builder,
         "batch": batch,
         "ips": round(1000.0 * batch / ms, 1),
@@ -140,7 +154,9 @@ def attn_child() -> int:
         # record which path 'pallas' ACTUALLY takes — parity of an XLA
         # fallback against XLA proves nothing about the Mosaic kernel
         kernel_runs = bool(ak.kernel_ok(q))
-        rec = {"seq": s, "head_dim": d, "heads": h,
+        rec = {**({"smoke": True} if os.environ.get("ATTN_SWEEP_POINTS")
+                  else {}),
+               "seq": s, "head_dim": d, "heads": h,
                "backend": backend,
                "pallas_path": ("mosaic" if kernel_runs and backend == "tpu"
                                else "interpret" if kernel_runs
@@ -217,13 +233,15 @@ def decode_child() -> int:
             _paged_pallas, _xla_paged, paged_kernel_ok)
 
         rng = np.random.default_rng(1)
-        h, d, page, mp, np_ = 12, 64, 64, 32, 40
-        q = jnp.asarray(rng.normal(size=(8, h, d)), jnp.bfloat16)
+        h, d, page, mp, np_, nb = 12, 64, 64, 32, 40, 8
+        if os.environ.get("DECODE_SWEEP_SMALL"):  # CPU interpret-mode cost
+            h, d, page, mp, np_, nb = 2, 64, 8, 4, 6, 2
+        q = jnp.asarray(rng.normal(size=(nb, h, d)), jnp.bfloat16)
         kp = jnp.asarray(rng.normal(size=(np_, page, h, d)), jnp.bfloat16)
         vp = jnp.asarray(rng.normal(size=(np_, page, h, d)), jnp.bfloat16)
-        tbl = jnp.asarray(np.tile(np.arange(mp) % (np_ - 1) + 1, (8, 1)),
+        tbl = jnp.asarray(np.tile(np.arange(mp) % (np_ - 1) + 1, (nb, 1)),
                           jnp.int32).at[:, 2:].set(0)  # 2 live pages/slot
-        pos = jnp.full((8,), 2 * page - 1, jnp.int32)
+        pos = jnp.full((nb,), 2 * page - 1, jnp.int32)
         assert paged_kernel_ok(q, kp)  # shapes chosen kernel-eligible
         got = _paged_pallas(q, kp, vp, tbl, pos)
         ref = _xla_paged(q, kp, vp, tbl, pos)
@@ -240,6 +258,8 @@ def decode_child() -> int:
         results["paged_kernel_error"] = str(e)[-300:]
 
     results["device"] = jax.devices()[0].device_kind
+    if os.environ.get("DECODE_SWEEP_SMALL"):
+        results["smoke"] = True
     print(json.dumps(results))
     return 0
 
@@ -329,6 +349,8 @@ def batcher_child() -> int:
         results["kv_hbm_bytes_8_streams_paged"]
         / results["kv_hbm_bytes_8_streams"], 3)
     results["device"] = jax.devices()[0].device_kind
+    if os.environ.get("DECODE_SWEEP_SMALL"):
+        results["smoke"] = True
     print(json.dumps(results))
     return 0
 
@@ -357,20 +379,24 @@ def serving_child() -> int:
     import jax
 
     n_clients, per_client = 8, 25
+    backbone, side, max_batch = "resnet50", 224, 32
     if os.environ.get("SERVING_SWEEP_SMALL"):  # CPU smoke override
+        # tiny sibling backbone: same endpoint path (decode -> resize ->
+        # padded batch forward -> tap reply) at CPU-smoke cost
         n_clients, per_client = 2, 4
+        backbone, side, max_batch = "resnet18", 32, 4
 
-    bundle = FlaxBundle("resnet50", {"num_classes": 1000},
-                        input_shape=(224, 224, 3))
+    bundle = FlaxBundle(backbone, {"num_classes": 1000},
+                        input_shape=(side, side, 3))
     feat = ImageFeaturizer(bundle=bundle, input_col="image_bytes",
-                           output_col="features", batch_size=32,
+                           output_col="features", batch_size=max_batch,
                            pad_to_batch=True)
     b64_decode = LambdaTransformer(lambda t: t.with_column(
         "image_bytes", np.asarray(
             [base64.b64decode(s) for s in t["image"]], dtype=object)))
     srv = ServingServer(model=PipelineModel([b64_decode, feat]),
                         reply_col="features", name="img", path="/featurize",
-                        max_batch=32, batch_timeout_ms=5.0)
+                        max_batch=max_batch, batch_timeout_ms=5.0)
     info = srv.start()
 
     jpeg = bytes(_bench._synthetic_jpeg_table(1)["image"][0])
@@ -414,6 +440,7 @@ def serving_child() -> int:
         return 1
     flat = lat.reshape(-1) * 1000.0
     print(json.dumps({
+        **({"smoke": True} if os.environ.get("SERVING_SWEEP_SMALL") else {}),
         "serving_chip_p50_ms": round(float(np.percentile(flat, 50)), 2),
         "serving_chip_p99_ms": round(float(np.percentile(flat, 99)), 2),
         "serving_chip_qps": round(n_clients * per_client / wall, 1),
