@@ -352,13 +352,18 @@ class ContinuousBatcher:
             raise
         handle = self._next_prefix
         self._next_prefix += 1
-        self._prefixes[handle] = {
-            "ids": ids, "pages": pages, "shared": shared,
-            # logits at the last prefix position: the first generated
-            # token when a request adds no suffix
-            "last_logits": np.asarray(logits[0, len(ids) - 1]),
-            "refs": 0,
-        }
+        # under _submit_lock (re-entrant on the inline path): submit()
+        # iterates _prefixes.values() for the page ceiling under this
+        # lock from client threads — an unguarded insert from the loop
+        # thread would intermittently blow up that iteration
+        with self._submit_lock:
+            self._prefixes[handle] = {
+                "ids": ids, "pages": pages, "shared": shared,
+                # logits at the last prefix position: the first generated
+                # token when a request adds no suffix
+                "last_logits": np.asarray(logits[0, len(ids) - 1]),
+                "refs": 0,
+            }
         return handle
 
     def _exec_release_prefix(self, handle: int):

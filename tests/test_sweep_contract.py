@@ -147,3 +147,26 @@ def test_chip_session_stage_list_dryrun():
         if "mfu_sweep.py" in cmd:
             for flag in re.findall(r"--[\w-]+", cmd):
                 assert flag in help_text, (cmd, flag)
+
+
+def test_roofline_modes_emit_json():
+    """tools/roofline.py feeds docs/performance.md's pre-registered
+    ceiling table; every mode must emit parseable JSON with physical
+    (0, 1] MFU ceilings, or the table can silently rot."""
+    roofline = os.path.join(REPO, "tools", "roofline.py")
+    for model in ("resnet50", "vit_base", "lm_train", "decode", "all"):
+        proc = subprocess.run(
+            [sys.executable, roofline, "--model", model],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert proc.returncode == 0, (model, proc.stderr[-500:])
+        recs = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+        assert recs, model
+        for rec in recs:
+            if "mfu_ceiling" in rec:
+                assert 0.0 < rec["mfu_ceiling"] <= 1.0, rec
+        if model == "decode":
+            (rec,) = recs
+            assert rec["decode_tok_per_sec_ceiling_int8"] > \
+                rec["decode_tok_per_sec_ceiling_f32"]
+        if model == "all":
+            assert len(recs) == 4

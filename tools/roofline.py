@@ -85,17 +85,146 @@ def analyze(batch: int, peak_flops: float, hbm_bw: float):
     }
 
 
+def _matmul_rows(ops, peak_flops, hbm_bw):
+    """Shared roofline accounting for a matmul inventory: each op is
+    (name, flops, bytes, n); min runtime = max(MXU time, HBM time)."""
+    rows = []
+    tot_t = tot_flops = 0.0
+    for name, flops, bytes_, n in ops:
+        flops *= n
+        bytes_ *= n
+        t_mxu = flops / peak_flops
+        t_hbm = bytes_ / hbm_bw
+        t = max(t_mxu, t_hbm)
+        rows.append({
+            "op": name, "flops_G": round(flops / 1e9, 1),
+            "bytes_M": round(bytes_ / 1e6, 1),
+            "bound": "mxu" if t_mxu >= t_hbm else "hbm",
+            "t_us": round(t * 1e6, 1),
+        })
+        tot_t += t
+        tot_flops += flops
+    return rows, tot_t, tot_flops
+
+
+def analyze_vit(batch, peak_flops, hbm_bw, seq=196, e=768, layers=12,
+                mlp=4):
+    """ViT-B/16 bf16 forward (bench.py `_measure_vit`'s config: batch 128,
+    224px -> S=196).  Almost pure matmul: the per-layer weight read
+    (12 E^2 bytes) amortizes over the whole batch, so arithmetic
+    intensity ~ batch * S — MXU-bound everywhere at batch 128."""
+    B = BYTES
+    act = batch * seq * e * B                     # one [B, S, E] tensor
+    ops = [
+        # patchify: [B*S, P^2*3=768] @ [768, E]
+        ("patch_embed", 2.0 * batch * seq * 768 * e,
+         batch * seq * 768 * B + act + 768 * e * B, 1),
+        ("qkv", 2.0 * batch * seq * e * 3 * e,
+         act + 3 * act + 3 * e * e * B, layers),
+        # scores + attn@v over all heads: 2 * 2 * B*S^2*E flops; fused
+        # attention keeps the S^2 scores in VMEM — traffic is q,k,v in,
+        # o out
+        ("attention", 4.0 * batch * seq * seq * e, 4 * act, layers),
+        ("proj", 2.0 * batch * seq * e * e, 2 * act + e * e * B, layers),
+        ("mlp", 2.0 * 2.0 * batch * seq * e * mlp * e,
+         (2 + 2 * mlp) * act + 2 * mlp * e * e * B, layers),
+        # 2 pre-LNs + residuals per layer: pure HBM epilogue traffic XLA
+        # fuses; modeled as one extra read+write of the activation each
+        ("ln_residual", 0.0, 4 * act, layers),
+    ]
+    rows, tot_t, tot_flops = _matmul_rows(ops, peak_flops, hbm_bw)
+    return rows, {
+        "model": "vit_base", "batch": batch,
+        "total_flops_G": round(tot_flops / 1e9, 1),
+        "min_time_ms": round(tot_t * 1e3, 2),
+        "ips_ceiling": round(batch / tot_t, 0),
+        "mfu_ceiling": round(tot_flops / peak_flops / tot_t, 3),
+    }
+
+
+def analyze_lm_train(batch, peak_flops, hbm_bw, seq=1024, e=768,
+                     layers=12, vocab=8192, mlp=4):
+    """TransformerLM fwd+bwd+adam (bench.py `_measure_transformer`:
+    batch 16, seq 1024, GPT-small-ish).  Backward = 2x forward matmul
+    FLOPs (the standard dL/dW + dL/dx decomposition); optimizer traffic
+    = params + 2 adam moments read/written in f32."""
+    B = BYTES
+    act = batch * seq * e * B
+    n_params = (vocab * e * 2            # in + out embeddings (untied)
+                + layers * 12 * e * e)   # qkv + proj + 2 mlp mats
+    fwd = [
+        ("qkv", 2.0 * batch * seq * e * 3 * e,
+         4 * act + 3 * e * e * B, layers),
+        ("attention", 4.0 * batch * seq * seq * e, 4 * act, layers),
+        ("proj", 2.0 * batch * seq * e * e, 2 * act + e * e * B, layers),
+        ("mlp", 4.0 * batch * seq * e * mlp * e,
+         (2 + 2 * mlp) * act + 2 * mlp * e * e * B, layers),
+        ("ln_residual", 0.0, 4 * act, layers),
+        ("lm_head", 2.0 * batch * seq * e * vocab,
+         act + batch * seq * vocab * B + vocab * e * B, 1),
+    ]
+    # fwd + 2x matmul flops for bwd; bwd traffic ~ 2x fwd's (grads +
+    # saved activations)
+    ops = [(f"{n}+bwd", 3.0 * f, 3.0 * by, k) for n, f, by, k in fwd]
+    ops.append(("adam_update", 0.0, n_params * 4 * (3 + 3), 1))
+    rows, tot_t, tot_flops = _matmul_rows(ops, peak_flops, hbm_bw)
+    return rows, {
+        "model": "lm_train", "batch": batch, "seq": seq,
+        "params_M": round(n_params / 1e6, 1),
+        "total_flops_G": round(tot_flops / 1e9, 1),
+        "min_time_ms": round(tot_t * 1e3, 2),
+        "tokens_per_sec_ceiling": round(batch * seq / tot_t, 0),
+        "mfu_ceiling": round(tot_flops / peak_flops / tot_t, 3),
+    }
+
+
+def analyze_decode(peak_flops, hbm_bw, e=768, layers=12, vocab=8192,
+                   ctx=512, mlp=4):
+    """Batch-1 KV-cached decode (mfu_sweep --decode's config).  Pure
+    bandwidth: every token must stream all weights + the live KV rows;
+    the MXU term is ~zero (matrix-vector).  Ceiling = BW / bytes-per-
+    token — the number the f32 vs int8 sweep ratio is judged against."""
+    n_params = vocab * e * 2 + layers * 12 * e * e
+    kv_row = 2 * e  # K + V per layer per position (heads*d = e)
+    out = {}
+    for tag, wbytes, kvbytes in (("f32", 4, 4), ("int8", 1, 1)):
+        per_tok = (n_params * wbytes
+                   + layers * kv_row * (ctx // 2) * kvbytes)  # avg live len
+        out[f"decode_tok_per_sec_ceiling_{tag}"] = round(hbm_bw / per_tok, 0)
+        out[f"bytes_per_token_M_{tag}"] = round(per_tok / 1e6, 1)
+    out.update({"model": "decode_b1", "params_M": round(n_params / 1e6, 1),
+                "ctx_avg": ctx // 2,
+                "int8_ceiling_ratio": round(
+                    out["decode_tok_per_sec_ceiling_int8"]
+                    / out["decode_tok_per_sec_ceiling_f32"], 2)})
+    return [], out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--peak-tflops", type=float, default=197.0)
     ap.add_argument("--hbm-gbs", type=float, default=819.0)
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "vit_base", "lm_train", "decode",
+                             "all"],
+                    help="which analytic ceiling to print (docs/"
+                         "performance.md's pre-registered target table)")
     args = ap.parse_args()
-    rows, summary = analyze(args.batch, args.peak_tflops * 1e12,
-                            args.hbm_gbs * 1e9)
-    for r in rows:
-        print(json.dumps(r))
-    print(json.dumps(summary))
+    peak, bw = args.peak_tflops * 1e12, args.hbm_gbs * 1e9
+    analyzers = {
+        "resnet50": lambda: analyze(args.batch, peak, bw),
+        "vit_base": lambda: analyze_vit(128, peak, bw),
+        "lm_train": lambda: analyze_lm_train(16, peak, bw),
+        "decode": lambda: analyze_decode(peak, bw),
+    }
+    names = list(analyzers) if args.model == "all" else [args.model]
+    for name in names:
+        rows, summary = analyzers[name]()
+        if args.model != "all":
+            for r in rows:
+                print(json.dumps(r))
+        print(json.dumps(summary))
 
 
 if __name__ == "__main__":
