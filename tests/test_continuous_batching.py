@@ -835,10 +835,48 @@ def test_decode_mode_throughput_ratios_regression():
                              tps["paged"] / tps["dense"])
             assert_benchmark(bench, "decode_spec_over_dense",
                              tps["spec"] / tps["dense"])
-            assert_benchmark(bench, "decode_paged_hbm_ratio",
-                             hbm["paged"] / hbm["dense"])
+            # deterministic pool sizing: exact BOTH ways — an
+            # under-allocated pool (silently shrunk cache) must fail
+            # just like an over-allocated one
+            assert abs(hbm["paged"] / hbm["dense"] - 0.3125) < 1e-6, hbm
             return
         except AssertionError as e:
             last = e
             _time.sleep(1.0)
     raise last
+
+
+# ------------------------------- serving across devices (tensor parallel)
+
+def test_paged_batcher_on_tensor_parallel_target(lm, draft_lm):
+    """The serving stack's scale-out composition (SURVEY §2.10; the
+    TPU-native answer to HTTPSourceV2's cluster fan-out): the continuous
+    batcher drives a tp=2-sharded TransformerLM on the virtual 8-device
+    mesh — GSPMD shards the decode-step matmuls over 'model' while the
+    page pools/tables stay replicated host-driven state.  Paged AND
+    paged+speculative streams must equal the unsharded generate()."""
+    from mmlspark_tpu.models.training import shard_params
+    from mmlspark_tpu.parallel.mesh import MeshContext, make_mesh
+    from mmlspark_tpu.parallel.sharding_rules import lm_tensor_parallel_rules
+
+    model, variables = lm
+    draft, dv = draft_lm
+    mesh = make_mesh(data=jax.device_count() // 2, model=2)
+    with MeshContext(mesh):
+        tp_vars = {"params": shard_params(variables["params"], mesh,
+                                          lm_tensor_parallel_rules)}
+        prompts = [[2, 7, 1, 8], [5, 5], [9] * 11]
+        for kw in ({"paged": True, "page_size": 8},
+                   {"paged": True, "page_size": 8, "draft_model": draft,
+                    "draft_variables": dv, "gamma": 3}):
+            batcher = ContinuousBatcher(model, tp_vars, max_slots=2,
+                                        **kw).start()
+            try:
+                streams = [batcher.submit(p, max_new_tokens=6)
+                           for p in prompts]
+                got = [s.tokens() for s in streams]
+            finally:
+                batcher.stop()
+            for p, toks in zip(prompts, got):
+                ref = _reference(model, variables, p, 6)
+                assert toks == ref, (kw, p, toks, ref)
