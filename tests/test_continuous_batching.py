@@ -835,10 +835,13 @@ def test_decode_mode_throughput_ratios_regression():
                              tps["paged"] / tps["dense"])
             assert_benchmark(bench, "decode_spec_over_dense",
                              tps["spec"] / tps["dense"])
-            # deterministic pool sizing: exact BOTH ways — an
-            # under-allocated pool (silently shrunk cache) must fail
-            # just like an over-allocated one
-            assert abs(hbm["paged"] / hbm["dense"] - 0.3125) < 1e-6, hbm
+            # deterministic pool sizing: two-sided against the committed
+            # CSV row — an under-allocated pool (silently shrunk cache)
+            # must fail just like an over-allocated one, and the CSV
+            # stays the single arbiter a maintainer edits
+            expected, prec, _hb = bench["decode_paged_hbm_ratio"]
+            assert abs(hbm["paged"] / hbm["dense"] - expected) <= prec, (
+                hbm, expected)
             return
         except AssertionError as e:
             last = e
