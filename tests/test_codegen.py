@@ -119,27 +119,32 @@ def _r_function_blocks(src: str):
     return blocks
 
 
+def _r_parse_gate(path: str):
+    """Balanced-delimiter structure check + a real `Rscript` parse when
+    an interpreter exists (not in this CI image) — the ONE gate both R
+    artifacts (stages.R, tests/smoke.R) go through."""
+    import shutil
+
+    src = open(path).read()
+    for ch_open, ch_close in ("()", "{}"):
+        assert src.count(ch_open) == src.count(ch_close), path
+    rscript = shutil.which("Rscript")
+    if rscript:
+        proc = subprocess.run(
+            [rscript, "-e", f'invisible(parse(file="{path}"))'],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-1000:]
+    return src
+
+
 def test_generated_r_package_structure(tmp_path):
     import re
-    import shutil
 
     from mmlspark_tpu.codegen import generate_r_wrappers
 
     pkg = generate_r_wrappers(str(tmp_path))
-    src = open(os.path.join(pkg, "R", "stages.R")).read()
-
-    # a real parse when the interpreter exists (not in this CI image)
-    rscript = shutil.which("Rscript")
-    if rscript:
-        proc = subprocess.run(
-            [rscript, "-e", f'invisible(parse(file="{pkg}/R/stages.R"))'],
-            capture_output=True, text=True, timeout=120)
-        assert proc.returncode == 0, proc.stderr[-1000:]
-
-    # structure: balanced delimiters, no unterminated strings
-    for ch_open, ch_close in ("()", "{}"):
-        assert src.count(ch_open) == src.count(ch_close)
-    assert src.count('"') % 2 == 0
+    src = _r_parse_gate(os.path.join(pkg, "R", "stages.R"))
+    assert src.count('"') % 2 == 0  # no unterminated strings
 
     # one constructor per registered stage, exported, registry-consistent
     blocks = _r_function_blocks(src)
@@ -163,3 +168,53 @@ def test_generated_r_package_structure(tmp_path):
         assert f".bindings()${name}" in body
         assert "Filter(Negate(is.null), kwargs)" in body
     assert 'reticulate::import("mmlspark_tpu_bindings")' in src
+
+
+def test_generated_r_smoke_script(tmp_path, generated):
+    """The emitted tests/smoke.R is the execution evidence for the
+    reference's testR discipline (CodegenPlugin.scala:60).  In an R +
+    reticulate environment the script EXECUTES here (it bootstraps its
+    own bindings via py_run_string, so it is self-sufficient); in this
+    CI image (no R — recorded descope, README "Bindings") it is
+    parse-gated and its Python SEMANTICS are executed directly: the
+    exact stage construction + data.frame round-trip the script
+    performs, through the same generated binding the R function
+    dispatches to."""
+    import shutil
+
+    from mmlspark_tpu.codegen import generate_r_wrappers
+
+    pkg = generate_r_wrappers(str(tmp_path))
+    smoke = os.path.join(pkg, "tests", "smoke.R")
+    src = _r_parse_gate(smoke)
+    assert "ml_unicode_normalize" in src          # calls a real wrapper
+    assert 'source(file.path("R", "stages.R"))' in src
+    assert "generate_wrappers" in src             # self-bootstraps bindings
+
+    rscript = shutil.which("Rscript")
+    has_reticulate = rscript and subprocess.run(
+        [rscript, "-e", "library(reticulate)"], capture_output=True,
+        timeout=120).returncode == 0
+    if has_reticulate:  # full execution — the actual testR analog
+        proc = subprocess.run(
+            [rscript, os.path.join("tests", "smoke.R")], cwd=pkg,
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-1000:]
+        assert "R smoke ok" in proc.stdout
+
+    # execute the script's semantics through the generated PYTHON binding
+    # (reticulate's target): ml_unicode_normalize(inputCol=, outputCol=)
+    # -> .bindings()$UnicodeNormalize(**kwargs) -> transform(data.frame)
+    import importlib
+    import pandas as pd
+
+    out_dir, _, _ = generated
+    sys.path.insert(0, out_dir)
+    try:
+        bindings = importlib.reload(
+            importlib.import_module("mmlspark_tpu_bindings"))
+        stage = bindings.UnicodeNormalize(inputCol="text", outputCol="norm")
+        out = stage.transform(pd.DataFrame({"text": ["a b a", "b c"]}))
+        assert "norm" in out.columns and len(out) == 2
+    finally:
+        sys.path.remove(out_dir)
