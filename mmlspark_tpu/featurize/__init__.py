@@ -3,3 +3,4 @@ from .clean_missing import CleanMissingData, CleanMissingDataModel
 from .featurize import Featurize, FeaturizeModel, DataConversion, CountSelector, CountSelectorModel
 from .text import TextFeaturizer, TextFeaturizerModel, MultiNGram, PageSplitter
 from .tokenizer import BPETokenizer, BPETokenizerModel
+from .word2vec import Word2Vec, Word2VecModel

@@ -1,0 +1,192 @@
+"""Word2Vec: skip-gram with negative sampling, trained as jitted batches.
+
+Reference workload parity: the reference's "TextAnalytics - Amazon Book
+Reviews with Word2Vec" notebook composes SparkML's `Word2Vec` with
+mmlspark's TrainClassifier; a user switching engines needs the embedding
+trainer too, so it lives here as a first-class stage with SparkML's
+surface (vector_size/window_size/min_count, doc vector = MEAN of word
+vectors, `find_synonyms`).
+
+TPU-first design: vocabulary/pair extraction is host-side (string work),
+but ALL arithmetic is one jitted `lax.scan` over fixed-size minibatches
+of (center, context, negatives) triples — adagrad updates on two
+embedding tables, negatives drawn with the unigram^0.75 distribution via
+stateless `jax.random` so the whole epoch is a single device program
+(no per-batch host round trips, same scan shape as models/training.py).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.registry import register_stage
+
+__all__ = ["Word2Vec", "Word2VecModel"]
+
+
+def _tokenize_col(col) -> List[List[str]]:
+    # raw strings go through the SAME tokenizer as TextFeaturizer
+    # (text.py _tokenize, \W+ split): the two recipes the Amazon-reviews
+    # notebooks put side by side must see one vocabulary, not two
+    from .text import _tokenize
+
+    docs = []
+    for doc in col:
+        if isinstance(doc, str):
+            docs.append(_tokenize(doc))
+        else:
+            docs.append([str(t) for t in doc])
+    return docs
+
+
+@register_stage
+class Word2Vec(Estimator):
+    """Skip-gram negative-sampling embeddings (SparkML Word2Vec surface)."""
+
+    input_col = Param("tokens (list) or raw text column", default="text")
+    output_col = Param("document vector column", default="features")
+    vector_size = Param("embedding dim", default=32,
+                        converter=TypeConverters.to_int)
+    window_size = Param("context window radius", default=3,
+                        converter=TypeConverters.to_int)
+    min_count = Param("drop words rarer than this", default=2,
+                      converter=TypeConverters.to_int)
+    negatives = Param("negative samples per pair", default=4,
+                      converter=TypeConverters.to_int)
+    epochs = Param("passes over the pair set", default=3,
+                   converter=TypeConverters.to_int)
+    learning_rate = Param("adagrad lr", default=0.25,
+                          converter=TypeConverters.to_float)
+    batch_size = Param("pairs per scanned step", default=1024,
+                       converter=TypeConverters.to_int)
+    seed = Param("sampling seed", default=0, converter=TypeConverters.to_int)
+
+    def _fit(self, table) -> "Word2VecModel":
+        import jax
+        import jax.numpy as jnp
+
+        docs = _tokenize_col(table[self.input_col])
+        counts: dict = {}
+        for toks in docs:
+            for t in toks:
+                counts[t] = counts.get(t, 0) + 1
+        vocab = sorted(w for w, c in counts.items() if c >= self.min_count)
+        if not vocab:
+            raise ValueError("Word2Vec: no word meets min_count")
+        index = {w: i for i, w in enumerate(vocab)}
+        v, d = len(vocab), int(self.vector_size)
+
+        # host-side pair extraction (string work); arithmetic stays on device
+        centers, contexts = [], []
+        w = int(self.window_size)
+        for toks in docs:
+            ids = [index[t] for t in toks if t in index]
+            for i, c in enumerate(ids):
+                for j in range(max(0, i - w), min(len(ids), i + w + 1)):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(ids[j])
+        if not centers:
+            raise ValueError("Word2Vec: no training pairs (docs too short)")
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+
+        # unigram^0.75 negative-sampling table (the word2vec paper's choice)
+        freq = np.asarray([counts[wd] for wd in vocab], np.float64) ** 0.75
+        neg_probs = jnp.asarray(freq / freq.sum(), jnp.float32)
+
+        # a corpus smaller than one batch still trains: narrow the batch
+        # to the pair count instead of feeding reshape a short array
+        b = min(int(self.batch_size), len(centers))
+        rng = np.random.default_rng(int(self.seed))
+        order = rng.permutation(len(centers))
+        n_batches = max(1, len(order) // b)
+        order = order[: n_batches * b]
+        cen = jnp.asarray(centers[order].reshape(n_batches, b))
+        ctx = jnp.asarray(contexts[order].reshape(n_batches, b))
+
+        k = int(self.negatives)
+        lr = float(self.learning_rate)
+
+        def step(state, batch):
+            (w_in, w_out, g_in, g_out, key) = state
+            c, o = batch
+            key, sub = jax.random.split(key)
+            neg = jax.random.choice(sub, v, shape=(b, k), p=neg_probs)
+
+            def loss_fn(params):
+                wi, wo = params
+                vc = wi[c]                              # [b, d]
+                pos = jnp.sum(vc * wo[o], axis=-1)      # [b]
+                negs = jnp.einsum("bd,bkd->bk", vc, wo[neg])
+                return -(jnp.mean(jax.nn.log_sigmoid(pos))
+                         + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs),
+                                            axis=-1)))
+
+            loss, (gi, go) = jax.value_and_grad(loss_fn)((w_in, w_out))
+            # adagrad: per-parameter step decay, the classic w2v schedule
+            g_in = g_in + gi ** 2
+            g_out = g_out + go ** 2
+            w_in = w_in - lr * gi / jnp.sqrt(g_in + 1e-8)
+            w_out = w_out - lr * go / jnp.sqrt(g_out + 1e-8)
+            return (w_in, w_out, g_in, g_out, key), loss
+
+        key = jax.random.PRNGKey(int(self.seed))
+        init = ((jax.random.uniform(key, (v, d), jnp.float32, -0.5, 0.5)
+                 / d),
+                jnp.zeros((v, d), jnp.float32),
+                jnp.zeros((v, d), jnp.float32),
+                jnp.zeros((v, d), jnp.float32),
+                key)
+
+        @jax.jit
+        def epoch(state):
+            return jax.lax.scan(step, state, (cen, ctx))
+
+        state = init
+        losses = []
+        for _ in range(int(self.epochs)):
+            state, ls = epoch(state)
+            losses.append(float(jnp.mean(ls)))
+        vectors = np.asarray(state[0], np.float32)
+        return Word2VecModel(
+            input_col=self.input_col, output_col=self.output_col,
+            vocabulary=vocab, vectors=vectors,
+            training_losses=losses,
+        )
+
+
+@register_stage
+class Word2VecModel(Model):
+    input_col = Param("tokens (list) or raw text column", default="text")
+    output_col = Param("document vector column", default="features")
+    vocabulary = ComplexParam("word list, row-aligned with vectors")
+    vectors = ComplexParam("embedding matrix [V, D]")
+    training_losses = ComplexParam("mean NEG loss per epoch", default=None)
+
+    def _transform(self, table):
+        index = {w: i for i, w in enumerate(self.vocabulary)}
+        vecs = np.asarray(self.vectors, np.float32)
+        d = vecs.shape[1]
+        out = np.zeros((len(table), d), np.float32)
+        for r, toks in enumerate(_tokenize_col(table[self.input_col])):
+            ids = [index[t] for t in toks if t in index]
+            if ids:  # SparkML semantics: mean of the word vectors
+                out[r] = vecs[ids].mean(axis=0)
+        return table.with_column(self.output_col, out)
+
+    def find_synonyms(self, word: str, num: int = 5):
+        """Cosine-nearest words, (word, similarity) descending —
+        SparkML's findSynonyms."""
+        index = {w: i for i, w in enumerate(self.vocabulary)}
+        if word not in index:
+            raise KeyError(f"{word!r} not in the trained vocabulary")
+        vecs = np.asarray(self.vectors, np.float32)
+        q = vecs[index[word]]
+        sims = (vecs @ q) / (np.linalg.norm(vecs, axis=1)
+                             * np.linalg.norm(q) + 1e-9)
+        order = [i for i in np.argsort(-sims) if i != index[word]][:num]
+        return [(self.vocabulary[i], float(sims[i])) for i in order]

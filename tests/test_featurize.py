@@ -118,3 +118,68 @@ class TestTextFeaturizer:
         assert len(pages) > 1
         assert all(len(p) <= 80 for p in pages)
         assert "".join(pages) == "word " * 100
+
+
+class TestWord2Vec:
+    """Skip-gram NEG embeddings (workload parity: the reference's Amazon
+    Book Reviews with Word2Vec notebook composes SparkML Word2Vec with
+    TrainClassifier — the trainer lives here so that recipe ports)."""
+
+    def _topic_docs(self, n=240):
+        rng = np.random.default_rng(3)
+        food = ["bread", "cheese", "apple", "soup", "butter"]
+        tool = ["hammer", "wrench", "drill", "saw", "pliers"]
+        docs, topics = [], []
+        for _ in range(n):
+            topic = food if rng.random() < 0.5 else tool
+            docs.append(" ".join(rng.choice(topic, size=8)))
+            topics.append(float(topic is food))
+        return docs, np.asarray(topics), food, tool
+
+    def test_synonyms_respect_topics(self):
+        from mmlspark_tpu.featurize import Word2Vec
+
+        docs, _y, food, _tool = self._topic_docs()
+        m = Word2Vec(vector_size=16, window_size=3, min_count=2,
+                     epochs=4, seed=1).fit(Table({"text": docs}))
+        assert m.training_losses[-1] < m.training_losses[0]
+        syn = [w for w, _s in m.find_synonyms("bread", 4)]
+        assert all(w in food for w in syn), syn
+
+    def test_doc_vectors_linearly_separate_topics(self):
+        from mmlspark_tpu.featurize import Word2Vec
+        from mmlspark_tpu.models.linear import LogisticRegression
+
+        docs, y, _f, _t = self._topic_docs()
+        m = Word2Vec(vector_size=16, min_count=2, epochs=4,
+                     seed=1).fit(Table({"text": docs}))
+        t = m.transform(Table({"text": docs})).with_column("label", y)
+        clf = LogisticRegression(max_iter=100).fit(t)
+        acc = float(np.mean(np.asarray(clf.transform(t)["prediction"]) == y))
+        assert acc > 0.95, acc
+
+    def test_oov_and_token_lists(self):
+        from mmlspark_tpu.featurize import Word2Vec
+
+        docs = ["a b a b c", "b a b a c"] * 4
+        m = Word2Vec(vector_size=4, min_count=2, epochs=1,
+                     batch_size=16).fit(Table({"text": docs}))
+        toks = np.empty(2, object)
+        toks[0] = ["a", "b", "zzz-unseen"]
+        toks[1] = ["zzz-unseen"]                      # all-OOV -> zeros
+        out = m.transform(Table({"text": toks}))
+        f = np.asarray(out["features"])
+        assert np.any(f[0] != 0) and np.all(f[1] == 0)
+        with pytest.raises(KeyError):
+            m.find_synonyms("zzz-unseen")
+
+    def test_small_corpus_default_batch_and_punctuation(self):
+        """A corpus with fewer pairs than batch_size must still train
+        (the batch narrows, not crash), and raw strings must tokenize
+        exactly like TextFeaturizer (\\W+), sharing one vocabulary."""
+        from mmlspark_tpu.featurize import Word2Vec
+
+        m = Word2Vec(min_count=1, epochs=1).fit(
+            Table({"text": ["superb. superb book, superb!"] * 3}))
+        assert "superb" in m.vocabulary
+        assert all("." not in w and "," not in w for w in m.vocabulary)

@@ -357,6 +357,15 @@ def _ex_image_featurizer():
     return ImageFeaturizer(bundle=_tiny_bundle(), batch_size=4), _img_table(4)
 
 
+@full("Word2Vec")
+def _ex_word2vec():
+    from mmlspark_tpu.featurize import Word2Vec
+    docs = ["bread cheese apple soup", "hammer wrench drill saw",
+            "bread soup cheese", "drill hammer saw wrench"] * 3
+    return Word2Vec(vector_size=8, min_count=2, epochs=1,
+                    batch_size=32), Table({"text": docs})
+
+
 @full("SequenceTagger")
 def _ex_seq_tagger():
     from mmlspark_tpu.models.bilstm import SequenceTagger
@@ -984,6 +993,7 @@ VIA_ESTIMATOR = {
     "GBDTRankerModel": "GBDTRanker",
     "IsolationForestModel": "IsolationForest",
     "SequenceTaggerModel": "SequenceTagger",
+    "Word2VecModel": "Word2Vec",
     "DeepVisionModel": "DeepVisionClassifier",
     "LinearRegressionModel": "LinearRegression",
     "LogisticRegressionModel": "LogisticRegression",
