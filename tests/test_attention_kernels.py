@@ -13,6 +13,19 @@ from mmlspark_tpu.ops.attention_kernels import (
 )
 from mmlspark_tpu.parallel.ring_attention import full_attention
 
+# On a real TPU the kernel's and the reference's matmuls both run on the
+# MXU, whose default f32 precision is bf16x3-pass accumulation — the two
+# paths round in different orders, so f32 "parity" is ~1e-3 there, not
+# 2e-5 (observed on-chip max abs diff 5e-3, tools/chip_logs/
+# 20260801T082912Z-tpu-tests.log). CPU interpret mode reproduces the XLA
+# composition at true f32, where the tight tolerance is the real test.
+_ON_TPU = jax.default_backend() == "tpu"
+# 2x margin over the observed on-chip diffs: forward max 5e-3, grad max
+# 0.036 (the sum-of-squares loss amplifies the forward's bf16 noise) —
+# tight enough that a Mosaic-only ~1e-2 forward regression still fails.
+F32_TOL = dict(atol=1e-2, rtol=1e-2) if _ON_TPU else dict(atol=2e-5, rtol=2e-5)
+GRAD_TOL = dict(atol=7.5e-2, rtol=7.5e-2) if _ON_TPU else dict(atol=1e-4, rtol=1e-4)
+
 
 @pytest.fixture(scope="module")
 def qkv():
@@ -27,8 +40,7 @@ def test_kernel_matches_xla(qkv, causal):
     q, k, v = qkv
     got = fused_attention(q, k, v, causal)
     ref = full_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **F32_TOL)
 
 
 def test_kernel_bf16_matches_xla_bf16(qkv):
@@ -49,8 +61,7 @@ def test_head_dim_padding_exact():
     got = fused_attention(q, k, v, True)
     ref = full_attention(q, k, v, causal=True)
     assert got.shape == (1, 128, 2, 64)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **F32_TOL)
 
 
 def test_grad_matches_xla(qkv):
@@ -65,8 +76,7 @@ def test_grad_matches_xla(qkv):
     g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **GRAD_TOL)
 
 
 def test_unkernelable_shapes_fall_back_to_xla():
@@ -108,8 +118,7 @@ def test_long_context_multiblock_parity(seq, causal):
     assert ak.kernel_ok(q)
     got = fused_attention(q, k, v, causal)
     ref = full_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **F32_TOL)
 
 
 def test_transformer_default_dispatch_uses_kernel(monkeypatch):
@@ -130,7 +139,7 @@ def test_transformer_default_dispatch_uses_kernel(monkeypatch):
                                atol=2e-4, rtol=2e-4)
 
 
-@pytest.mark.skipif("__import__('jax').default_backend() != 'tpu'",
+@pytest.mark.skipif(not _ON_TPU,
                     reason="Mosaic compile check needs a real TPU")
 def test_attention_kernel_compiles_on_tpu():
     rng = np.random.default_rng(3)
