@@ -26,7 +26,6 @@ if os.environ.get("JAX_PLATFORMS"):
 
     _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-import numpy as np
 
 from mmlspark_tpu import Table
 from mmlspark_tpu.cognitive import AzureSearchWriter

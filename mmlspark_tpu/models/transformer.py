@@ -462,8 +462,8 @@ class TransformerLM(nn.Module):
         if self.attn_fn is not None:
             attn = self.attn_fn
         elif _single_tpu():
-            # default dense attention rides the Pallas kernel on a single
-            # TPU: VMEM-resident scores, XLA-recompute backward (exact).
+            # default dense attention rides the Pallas kernels on a
+            # single TPU: VMEM-resident scores forward, flash backward.
             # Multi-device programs keep XLA dense (a Pallas custom call
             # is not GSPMD-partitionable) — sequence-parallel users pass
             # ring/ulysses attn_fns, which shard_map themselves.

@@ -23,9 +23,13 @@ Mosaic-friendly formulation (same playbook as pallas_kernels.py):
     is not a legal Mosaic tile).
 
 Training: fused_attention carries a custom VJP whose BACKWARD is the
-plain-XLA composition (recompute) — kernel-fast forward, exact XLA
-gradients, no second kernel to validate.  Forward-only callers (serving,
-featurization) never touch the backward path.
+flash-attention backward as two more Pallas kernels (one accumulates
+dK/dV streaming Q blocks, one accumulates dQ streaming K blocks),
+recomputing each score block in VMEM from the forward's saved
+logsumexp — the dense-XLA backward materialized f32 [B, H, S, S]
+score tensors per layer and was measured to be 71% of the whole LM
+train step on a v5e (tools/lm_ablate.py).  Shapes the forward kernel
+rejects keep the exact XLA-recompute backward.
 
 On CPU the kernel runs interpret=True (tests/CI); on TPU it compiles to
 Mosaic.  tests/test_attention_kernels.py holds the parity suite; the
@@ -66,16 +70,47 @@ def attention_fits_vmem(s: int, d: int, itemsize: int = 2,
                         block_q: int = _BLOCK_Q,
                         block_k: int = _BLOCK_K) -> bool:
     """Per-grid-step VMEM estimate — O(block_q * block_k), NOT O(S):
-    K/V blocks stream while o/m/l scratch persists."""
+    K/V blocks stream while the accumulators persist.  Taking the kernel
+    path commits callers to the flash BACKWARD too (custom_vjp), whose
+    dK/dV kernel stages the most: both estimates must fit."""
     d_p = _pad_up(d, _LANE)
     block_k = _pick_block_k(s) if block_k == _BLOCK_K else min(block_k, s)
     block_q = min(block_q, s)
-    staged = (2 * block_k * d_p * itemsize    # K + V blocks
-              + block_q * d_p * itemsize      # Q block
-              + 2 * block_q * block_k * 4     # scores + probs (f32)
-              + block_q * d_p * 4             # O scratch
-              + 2 * block_q * _LANE * 4)      # m / l scratch
-    return staged <= PALLAS_IMAGE_VMEM_BUDGET
+    fwd = (2 * block_k * d_p * itemsize       # K + V blocks
+           + block_q * d_p * itemsize         # Q block
+           + 2 * block_q * block_k * 4        # scores + probs (f32)
+           + block_q * d_p * 4                # O scratch
+           + 2 * block_q * _LANE * 4)         # m / l scratch
+    bwd = (2 * block_k * d_p * itemsize       # K + V blocks
+           + 2 * block_q * d_p * itemsize     # Q + dO blocks
+           + 2 * block_q * _LANE * 4          # lse + delta blocks
+           + 3 * block_q * block_k * 4        # p / dp / ds (f32)
+           + 2 * block_k * d_p * 4)           # dK + dV accumulators
+    return max(fwd, bwd) <= PALLAS_IMAGE_VMEM_BUDGET
+
+
+def _masked_scores(qb, kb, qi, ki, block_q, block_k, scale, causal):
+    """Score block sc = scale * Q K^T with the causal mask applied —
+    THE shared definition for the forward and both backward kernels, so
+    mask/scale/_NEG_INF semantics cannot desynchronize between them."""
+    sc = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [bq, bk]
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        mask = (qi * block_q + rows) >= (ki * block_k + cols)
+        sc = jnp.where(mask, sc, _NEG_INF)
+    return sc
+
+
+def _dscores(p, dob, vb, dlt, scale):
+    """ds = p * (dO V^T - delta) * scale — shared by both backward
+    kernels (dp in f32, ds cast at the consuming matmul)."""
+    dp = jax.lax.dot_general(
+        dob, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bq, bk]
+    return p * (dp - dlt) * scale
 
 
 @partial(jax.jit, static_argnames=("causal", "scale"))
@@ -91,7 +126,8 @@ def _attention_pallas(q, k, v, causal: bool, scale: float):
     block_k = _pick_block_k(s)
     n_k = s // block_k
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *, scale):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc,
+               *, scale):
         ki = pl.program_id(2)
         qi = pl.program_id(1)
 
@@ -111,14 +147,8 @@ def _attention_pallas(q, k, v, causal: bool, scale: float):
             qb = q_ref[0]                    # [block_q, D]
             kb = k_ref[0]                    # [block_k, D]
             vb = v_ref[0]
-            sc = jax.lax.dot_general(
-                qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [bq, bk]
-            if causal:
-                rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
-                cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-                mask = (qi * block_q + rows) >= (ki * block_k + cols)
-                sc = jnp.where(mask, sc, _NEG_INF)
+            sc = _masked_scores(qb, kb, qi, ki, block_q, block_k,
+                                scale, causal)
             # online softmax: m/l live lane-broadcast in [bq, LANE]
             # scratch.  Read via full-tile load + lane reduction (all
             # lanes hold the same value) — a narrow [:, :1] ref slice is
@@ -142,17 +172,27 @@ def _attention_pallas(q, k, v, causal: bool, scale: float):
             # reduction again (lanes are equal by construction).
             l_fin = jnp.max(l_acc[...], axis=-1, keepdims=True)
             o_ref[0] = o_acc[...] / jnp.maximum(l_fin, 1e-20)
+            # logsumexp residual for the flash backward: rows the causal
+            # mask fully hides never update m (=-inf stand-in) — their
+            # lse is meaningless and the backward masks them anyway
+            m_fin = jnp.max(m_acc[...], axis=-1, keepdims=True)
+            lse = m_fin + jnp.log(jnp.maximum(l_fin, 1e-20))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
     return pl.pallas_call(
         partial(kernel, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, s, _LANE), jnp.float32)),
         grid=(bh, s // block_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANE), jnp.float32),
@@ -166,6 +206,147 @@ def _xla_attention(q, k, v, causal: bool):
     from ..parallel.ring_attention import full_attention
 
     return full_attention(q, k, v, causal=causal)
+
+
+@partial(jax.jit, static_argnames=("causal", "scale"))
+def _attention_bwd_dkdv(q, k, v, do, lse, delta, causal: bool, scale: float):
+    """dK/dV: grid (BH, n_k, n_q) with Q innermost — each (b, k-block)
+    streams every visible Q/dO block, recomputing its score block from
+    the saved lse (p = exp(s - lse), exact, no renormalization pass),
+    accumulating dV += p^T dO and dK += ds^T Q in VMEM.  All inputs are
+    [BH, S, D_pad] except lse/delta [BH, S, LANE] lane-broadcast."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    block_q = min(_BLOCK_Q, s)
+    block_k = _pick_block_k(s)
+    n_q = s // block_q
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+               dk_ref, dv_ref, dk_acc, dv_acc, *, scale):
+        kj = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[...] = jnp.zeros_like(dk_acc)
+            dv_acc[...] = jnp.zeros_like(dv_acc)
+
+        visible = ((qi * block_q + block_q - 1 >= kj * block_k)
+                   if causal else (qi >= 0))
+
+        @pl.when(visible)
+        def _update():
+            qb = q_ref[0]
+            kb = k_ref[0]
+            vb = v_ref[0]
+            dob = do_ref[0]
+            lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)   # [bq, 1]
+            dlt = jnp.max(dl_ref[0], axis=-1, keepdims=True)    # [bq, 1]
+            sc = _masked_scores(qb, kb, qi, kj, block_q, block_k,
+                                scale, causal)
+            p = jnp.exp(sc - lse)                                # [bq, bk]
+            dv_acc[...] += jax.lax.dot_general(
+                p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [bk, D]
+            ds = _dscores(p, dob, vb, dlt, scale)
+            dk_acc[...] += jax.lax.dot_general(
+                ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [bk, D]
+
+        @pl.when(qi == n_q - 1)
+        def _finish():
+            dk_ref[0] = dk_acc[...]
+            dv_ref[0] = dv_acc[...]
+
+    return pl.pallas_call(
+        partial(kernel, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, s, d), jnp.float32)),
+        grid=(bh, s // block_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+
+@partial(jax.jit, static_argnames=("causal", "scale"))
+def _attention_bwd_dq(q, k, v, do, lse, delta, causal: bool, scale: float):
+    """dQ: grid (BH, n_q, n_k) with K innermost — the forward's layout,
+    accumulating dQ += ds @ K across the streamed K/V blocks."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    block_q = min(_BLOCK_Q, s)
+    block_k = _pick_block_k(s)
+    n_k = s // block_k
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+               dq_ref, dq_acc, *, scale):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_acc[...] = jnp.zeros_like(dq_acc)
+
+        visible = ((qi * block_q + block_q - 1 >= ki * block_k)
+                   if causal else (ki >= 0))
+
+        @pl.when(visible)
+        def _update():
+            qb = q_ref[0]
+            kb = k_ref[0]
+            vb = v_ref[0]
+            dob = do_ref[0]
+            lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
+            dlt = jnp.max(dl_ref[0], axis=-1, keepdims=True)
+            sc = _masked_scores(qb, kb, qi, ki, block_q, block_k,
+                                scale, causal)
+            p = jnp.exp(sc - lse)
+            ds = _dscores(p, dob, vb, dlt, scale)
+            dq_acc[...] += jax.lax.dot_general(
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [bq, D]
+
+        @pl.when(ki == n_k - 1)
+        def _finish():
+            dq_ref[0] = dq_acc[...]
+
+    return pl.pallas_call(
+        partial(kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        grid=(bh, s // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
 
 
 def kernel_ok(q) -> bool:
@@ -191,41 +372,69 @@ def fused_attention(q, k, v, causal: bool = True):
     falls back to the XLA composition when the shape can't take the
     kernel (S not a 128-multiple, or head dim < 64 where lane padding
     wastes the MXU).  Scale uses the TRUE head dim even when D pads to
-    the 128 lane.  Differentiable: the backward is the exact XLA
-    recompute.
+    the 128 lane.  Differentiable: kernel-path shapes take the flash
+    backward kernels (blockwise recompute from the saved logsumexp —
+    matches the XLA gradients to MXU precision, ~1e-3 on bf16 passes);
+    fallback shapes keep the exact XLA recompute.
     """
     return _fused_attention_fwd(q, k, v, causal)[0]
+
+
+def _to_bhsd(x, d_p):
+    """[B, S, H, D] -> [B*H, S, D_pad] (the kernels' layout)."""
+    b, s, h, d = x.shape
+    x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    if d_p != d:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, d_p - d)))
+    return x
+
+
+def _from_bhsd(x, b, s, h, d):
+    return x[..., :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 def _run_kernel(q, k, v, causal: bool):
     b, s, h, d = q.shape
     d_p = _pad_up(d, _LANE)
-
-    def to_bhsd(x):
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
-        if d_p != d:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_p - d)))
-        return x
-
-    o = _attention_pallas(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal,
-                          1.0 / float(d) ** 0.5)
-    o = o[..., :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
-    return o
+    o, lse = _attention_pallas(_to_bhsd(q, d_p), _to_bhsd(k, d_p),
+                               _to_bhsd(v, d_p), causal,
+                               1.0 / float(d) ** 0.5)
+    # keep one lane of the broadcast lse as the backward residual
+    return _from_bhsd(o, b, s, h, d), lse[..., 0]
 
 
 def _fused_attention_fwd(q, k, v, causal):
     if kernel_ok(q):
-        out = _run_kernel(q, k, v, causal)
-    else:
-        out = _xla_attention(q, k, v, causal)
-    return out, (q, k, v)
+        out, lse = _run_kernel(q, k, v, causal)
+        return out, (q, k, v, out, lse)
+    # fallback backward recomputes from q/k/v alone — saving `out` here
+    # would keep a dead [B, S, H, D] f32 alive until the backward
+    return _xla_attention(q, k, v, causal), (q, k, v, None, None)
 
 
 def _fused_attention_bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if lse is None:  # forward ran the XLA composition: exact recompute
+        _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal),
+                         q, k, v)
+        return vjp(g)
+    b, s, h, d = q.shape
+    d_p = _pad_up(d, _LANE)
+    scale = 1.0 / float(d) ** 0.5
+    # delta = rowsum(dO * O) on the TRUE head dim (pad columns are zero)
+    delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32), out)
+    delta = jnp.broadcast_to(delta.reshape(b * h, s)[..., None],
+                             (b * h, s, _LANE))
+    lse = jnp.broadcast_to(lse[..., None], (b * h, s, _LANE))
+    # matmul-heavy backward runs at the inputs' dtype (bf16 on the MXU)
+    # with f32 accumulation, like the forward
+    qp, kp, vp = (_to_bhsd(x, d_p) for x in (q, k, v))
+    dop = _to_bhsd(g.astype(q.dtype), d_p)
+    dk, dv = _attention_bwd_dkdv(qp, kp, vp, dop, lse, delta, causal, scale)
+    dq = _attention_bwd_dq(qp, kp, vp, dop, lse, delta, causal, scale)
+    return (_from_bhsd(dq, b, s, h, d).astype(q.dtype),
+            _from_bhsd(dk, b, s, h, d).astype(k.dtype),
+            _from_bhsd(dv, b, s, h, d).astype(v.dtype))
 
 
 fused_attention.defvjp(_fused_attention_fwd, _fused_attention_bwd)

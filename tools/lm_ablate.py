@@ -1,0 +1,92 @@
+"""On-chip ablation of the LM train step (bench.py's _measure_transformer
+workload): attributes the gap between measured step time and the FLOPs
+lower bound.  Each config prints one JSON line
+{tag, tokens_per_sec, mfu, ms_per_step}.
+
+Timing note (learned the hard way): on the tunneled TPU backend
+`jax.block_until_ready` can return before device execution finishes, so
+every measurement here blocks on an actual device->host fetch of the
+loss vector (np.asarray), the same thing a real training loop reads.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from mmlspark_tpu.models.transformer import transformer_lm
+from mmlspark_tpu.models.training import make_lm_train_epoch
+from mmlspark_tpu.parallel.ring_attention import full_attention
+
+
+def peak_flops():
+    return 197e12  # v5e bf16
+
+
+def _time_epoch(run_fetch, reps=3):
+    run_fetch()  # warm (drains the dispatch queue too)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_fetch()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False):
+    model = transformer_lm(vocab_size=8192, embed_dim=768, num_layers=12,
+                           num_heads=12, max_len=seq, dtype=jnp.bfloat16,
+                           attn_fn=attn_fn)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (steps, batch, seq), 0, 8192, jnp.int32)
+    params = jax.jit(lambda r, t: model.init(r, t)["params"])(rng, tokens[0])
+    if fwd_only:
+        def fwd_epoch(params, tokens):
+            def body(_, toks):
+                logits, _ = model.apply({"params": params}, toks)
+                lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+                ll = jnp.take_along_axis(lp, toks[:, 1:][..., None], axis=-1)
+                return None, -jnp.mean(ll)
+            _, losses = jax.lax.scan(body, None, tokens)
+            return losses
+        compiled = jax.jit(fwd_epoch).lower(params, tokens).compile()
+        run = lambda: np.asarray(compiled(params, tokens))
+        flops_step = 0.0
+    else:
+        opt = optax.adam(3e-4)
+        opt_state = jax.jit(opt.init)(params)
+        epoch = make_lm_train_epoch(model, opt, donate=False)
+        try:
+            cost = epoch.lower(params, opt_state, tokens[:1]).cost_analysis()
+            flops_step = float(cost["flops"])
+        except Exception:  # noqa: BLE001
+            flops_step = 0.0
+        compiled = epoch.lower(params, opt_state, tokens).compile()
+        run = lambda: np.asarray(compiled(params, opt_state, tokens)[2])
+    best = _time_epoch(run)
+    print(json.dumps({
+        "tag": tag,
+        "tokens_per_sec": round(steps * batch * seq / best, 0),
+        "mfu": (round(steps * flops_step / best / peak_flops(), 4)
+                if flops_step else None),
+        "ms_per_step": round(best / steps * 1e3, 2),
+        "flops_step_tf": round(flops_step / 1e12, 2),
+    }), flush=True)
+
+
+def main():
+    xla_attn = lambda q, k, v: full_attention(q, k, v, causal=True)
+    measure("baseline_b16")
+    measure("fwd_only_b16", fwd_only=True)
+    measure("xla_attn_b16", attn_fn=xla_attn)
+    measure("b32", batch=32)
+
+
+if __name__ == "__main__":
+    main()
