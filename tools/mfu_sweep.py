@@ -177,6 +177,43 @@ def attn_child() -> int:
                                        and rec["parity_ok"])
             failures += 0 if rec["parity_ok"] else 1
             rec["speedup"] = round(rec["xla_ms"] / rec["pallas_ms"], 2)
+            # flash BACKWARD: validate the dK/dV + dQ kernels under the
+            # same Mosaic compile and quantify them vs the dense-XLA
+            # gradient.  The dense reference materializes f32 [B,H,S,S]
+            # score tensors — skip it at s=4096 (multi-GB per tensor,
+            # OOM territory on one chip) and record kernel timing alone.
+            if kernel_runs:
+                loss_k = lambda q, k, v: jnp.sum(
+                    fused_attention(q, k, v, True).astype(jnp.float32) ** 2)
+                loss_x = lambda q, k, v: jnp.sum(
+                    full_attention(q, k, v, causal=True).astype(
+                        jnp.float32) ** 2)
+                gfn = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))
+                rec["bwd_pallas_ms"] = round(
+                    _bench_ms(lambda q, k, v: gfn(q, k, v)[0], q, k, v), 3)
+                if s <= 2048:
+                    gref = jax.jit(jax.grad(loss_x, argnums=(0, 1, 2)))
+                    g, gr = gfn(q, k, v), gref(q, k, v)
+                    rel = max(
+                        float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                              - b.astype(jnp.float32)))
+                              / (jnp.max(jnp.abs(
+                                  b.astype(jnp.float32))) + 1e-6))
+                        for a, b in zip(g, gr))
+                    del g, gr
+                    rec["bwd_max_rel_diff"] = round(rel, 5)
+                    rec["bwd_parity_ok"] = rel < 0.05
+                    # backward divergence un-validates the point: the
+                    # field means "compiled, ran, AND matched" for every
+                    # kernel the path commits callers to
+                    rec["mosaic_validated"] = (rec["mosaic_validated"]
+                                               and rec["bwd_parity_ok"])
+                    failures += 0 if rec["bwd_parity_ok"] else 1
+                    rec["bwd_xla_ms"] = round(
+                        _bench_ms(lambda q, k, v: gref(q, k, v)[0],
+                                  q, k, v), 3)
+                    rec["bwd_speedup"] = round(
+                        rec["bwd_xla_ms"] / rec["bwd_pallas_ms"], 2)
         except Exception as e:  # noqa: BLE001 — report, keep sweeping
             rec["error"] = str(e)[-300:]
             failures += 1
