@@ -95,6 +95,22 @@ def _single_tpu() -> bool:
     return jax.default_backend() == "tpu" and jax.device_count() == 1
 
 
+def default_attn(causal: bool):
+    """The default-attention dispatch shared by TransformerLM and ViT:
+    the Pallas kernel pair (VMEM-resident scores forward, flash
+    backward) on a single TPU, where dense XLA's f32 [B, H, S, S] score
+    traffic is pure HBM waste; XLA dense under GSPMD sharding (a Pallas
+    custom call is not partitionable).  Sequence-parallel users pass
+    ring/ulysses attn_fns instead, which shard_map themselves."""
+    if _single_tpu():
+        from ..ops.attention_kernels import fused_attention
+
+        return lambda q, k, v: fused_attention(q, k, v, causal)
+    from ..parallel.ring_attention import full_attention
+
+    return lambda q, k, v: full_attention(q, k, v, causal=causal)
+
+
 class _MoEMLP(nn.Module):
     """Switch-style top-1 mixture-of-experts MLP — the expert-parallel
     ('ep') building block.  TPU-idiomatic dispatch: routing is one-hot
@@ -457,21 +473,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-        from ..parallel.ring_attention import full_attention
-
-        if self.attn_fn is not None:
-            attn = self.attn_fn
-        elif _single_tpu():
-            # default dense attention rides the Pallas kernels on a
-            # single TPU: VMEM-resident scores forward, flash backward.
-            # Multi-device programs keep XLA dense (a Pallas custom call
-            # is not GSPMD-partitionable) — sequence-parallel users pass
-            # ring/ulysses attn_fns, which shard_map themselves.
-            from ..ops.attention_kernels import fused_attention
-
-            attn = lambda q, k, v: fused_attention(q, k, v, True)
-        else:
-            attn = lambda q, k, v: full_attention(q, k, v, causal=True)
+        attn = self.attn_fn if self.attn_fn is not None else default_attn(True)
         if self.pos_emb not in ("learned", "rope"):
             raise ValueError(
                 f"pos_emb must be 'learned' or 'rope', got "

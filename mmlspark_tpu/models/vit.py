@@ -26,7 +26,6 @@ from typing import Any, Dict, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ..parallel.ring_attention import full_attention
 from .transformer import _Block
 
 __all__ = ["VisionTransformer", "vit_tiny", "vit_small", "vit_base"]
@@ -71,7 +70,11 @@ class VisionTransformer(nn.Module):
                          (1, gh * gw, e), jnp.float32)
         x = x + pos.astype(self.dtype)
         taps["embed"] = x
-        attn = lambda q, k, v: full_attention(q, k, v, causal=False)
+        # shared dispatch rule with TransformerLM (transformer.default_attn):
+        # flash kernel pair on a single TPU — S=196 pads to the 256 grid
+        # with kv_valid masking — XLA dense under GSPMD sharding
+        from .transformer import default_attn
+        attn = default_attn(False)
         from ..ops.quant import dense_cls
         for i in range(self.num_layers):
             x = _Block(self.num_heads, self.mlp_ratio, self.dtype, attn,

@@ -89,17 +89,27 @@ def attention_fits_vmem(s: int, d: int, itemsize: int = 2,
     return max(fwd, bwd) <= PALLAS_IMAGE_VMEM_BUDGET
 
 
-def _masked_scores(qb, kb, qi, ki, block_q, block_k, scale, causal):
-    """Score block sc = scale * Q K^T with the causal mask applied —
-    THE shared definition for the forward and both backward kernels, so
-    mask/scale/_NEG_INF semantics cannot desynchronize between them."""
+def _masked_scores(qb, kb, qi, ki, block_q, block_k, scale, causal,
+                   kv_valid=None):
+    """Score block sc = scale * Q K^T with the causal and/or KV-padding
+    mask applied — THE shared definition for the forward and both
+    backward kernels, so mask/scale/_NEG_INF semantics cannot
+    desynchronize between them.  `kv_valid` (static) masks key columns
+    >= the true sequence length when S was padded up to the block grid:
+    zero-padded K rows would otherwise score 0 and steal softmax mass
+    from every valid query."""
     sc = jax.lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale      # [bq, bk]
-    if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+    if causal or kv_valid is not None:
         cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-        mask = (qi * block_q + rows) >= (ki * block_k + cols)
+        mask = None
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+        if kv_valid is not None:
+            kv_mask = (ki * block_k + cols) < kv_valid
+            mask = kv_mask if mask is None else (mask & kv_mask)
         sc = jnp.where(mask, sc, _NEG_INF)
     return sc
 
@@ -113,8 +123,9 @@ def _dscores(p, dob, vb, dlt, scale):
     return p * (dp - dlt) * scale
 
 
-@partial(jax.jit, static_argnames=("causal", "scale"))
-def _attention_pallas(q, k, v, causal: bool, scale: float):
+@partial(jax.jit, static_argnames=("causal", "scale", "kv_valid"))
+def _attention_pallas(q, k, v, causal: bool, scale: float,
+                      kv_valid=None):
     """q,k,v: [BH, S, D_padded] (D padded to a lane multiple) -> [BH, S,
     D_padded] f32.  `scale` is 1/sqrt(TRUE head dim) — the padded D must
     not leak into the softmax temperature."""
@@ -148,7 +159,7 @@ def _attention_pallas(q, k, v, causal: bool, scale: float):
             kb = k_ref[0]                    # [block_k, D]
             vb = v_ref[0]
             sc = _masked_scores(qb, kb, qi, ki, block_q, block_k,
-                                scale, causal)
+                                scale, causal, kv_valid)
             # online softmax: m/l live lane-broadcast in [bq, LANE]
             # scratch.  Read via full-tile load + lane reduction (all
             # lanes hold the same value) — a narrow [:, :1] ref slice is
@@ -208,8 +219,9 @@ def _xla_attention(q, k, v, causal: bool):
     return full_attention(q, k, v, causal=causal)
 
 
-@partial(jax.jit, static_argnames=("causal", "scale"))
-def _attention_bwd_dkdv(q, k, v, do, lse, delta, causal: bool, scale: float):
+@partial(jax.jit, static_argnames=("causal", "scale", "kv_valid"))
+def _attention_bwd_dkdv(q, k, v, do, lse, delta, causal: bool, scale: float,
+                        kv_valid=None):
     """dK/dV: grid (BH, n_k, n_q) with Q innermost — each (b, k-block)
     streams every visible Q/dO block, recomputing its score block from
     the saved lse (p = exp(s - lse), exact, no renormalization pass),
@@ -245,7 +257,7 @@ def _attention_bwd_dkdv(q, k, v, do, lse, delta, causal: bool, scale: float):
             lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)   # [bq, 1]
             dlt = jnp.max(dl_ref[0], axis=-1, keepdims=True)    # [bq, 1]
             sc = _masked_scores(qb, kb, qi, kj, block_q, block_k,
-                                scale, causal)
+                                scale, causal, kv_valid)
             p = jnp.exp(sc - lse)                                # [bq, bk]
             dv_acc[...] += jax.lax.dot_general(
                 p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
@@ -285,8 +297,9 @@ def _attention_bwd_dkdv(q, k, v, do, lse, delta, causal: bool, scale: float):
     )(q, k, v, do, lse, delta)
 
 
-@partial(jax.jit, static_argnames=("causal", "scale"))
-def _attention_bwd_dq(q, k, v, do, lse, delta, causal: bool, scale: float):
+@partial(jax.jit, static_argnames=("causal", "scale", "kv_valid"))
+def _attention_bwd_dq(q, k, v, do, lse, delta, causal: bool, scale: float,
+                      kv_valid=None):
     """dQ: grid (BH, n_q, n_k) with K innermost — the forward's layout,
     accumulating dQ += ds @ K across the streamed K/V blocks."""
     from jax.experimental import pallas as pl
@@ -318,7 +331,7 @@ def _attention_bwd_dq(q, k, v, do, lse, delta, causal: bool, scale: float):
             lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
             dlt = jnp.max(dl_ref[0], axis=-1, keepdims=True)
             sc = _masked_scores(qb, kb, qi, ki, block_q, block_k,
-                                scale, causal)
+                                scale, causal, kv_valid)
             p = jnp.exp(sc - lse)
             ds = _dscores(p, dob, vb, dlt, scale)
             dq_acc[...] += jax.lax.dot_general(
@@ -349,33 +362,51 @@ def _attention_bwd_dq(q, k, v, do, lse, delta, causal: bool, scale: float):
     )(q, k, v, do, lse, delta)
 
 
+def _padded_len(s: int):
+    """Kernel-grid sequence length for s, or None when the kernel should
+    decline.  Non-block-multiple lengths (ViT's S=196, ragged text) pad
+    up to the 128 grid with `kv_valid` masking — accepted only while the
+    padded work stays within 1.5x of the true length, past which the
+    masked blocks cost more than XLA dense's score traffic."""
+    if s < 8:
+        return None
+    if s % min(_BLOCK_Q, s) == 0 and s % 8 == 0:
+        return s                       # native fit, no padding
+    s_p = _pad_up(s, _BLOCK_Q)
+    return s_p if 2 * s_p <= 3 * s else None
+
+
 def kernel_ok(q) -> bool:
     """Public predicate: will fused_attention take the Pallas kernel for
     this (B, S, H, D) array, or fall back to the XLA composition?"""
     b, s, h, d = q.shape
     if not pallas_available():
         return False
-    if s % min(_BLOCK_Q, s) or s % 8 or s < 8:
+    s_p = _padded_len(s)
+    if s_p is None:
         return False
     # lane padding below d=64 (4x+ wasted MXU work and padded HBM copies)
     # makes the kernel a net loss vs XLA dense — keep small heads on XLA
     if d < 64:
         return False
-    return attention_fits_vmem(s, d, q.dtype.itemsize)
+    return attention_fits_vmem(s_p, d, q.dtype.itemsize)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3,))
 def fused_attention(q, k, v, causal: bool = True):
     """Drop-in for full_attention: (B, S, H, D) -> (B, S, H, D) f32.
 
-    VMEM-resident scores on TPU via Pallas (interpret mode elsewhere);
-    falls back to the XLA composition when the shape can't take the
-    kernel (S not a 128-multiple, or head dim < 64 where lane padding
-    wastes the MXU).  Scale uses the TRUE head dim even when D pads to
-    the 128 lane.  Differentiable: kernel-path shapes take the flash
-    backward kernels (blockwise recompute from the saved logsumexp —
-    matches the XLA gradients to MXU precision, ~1e-3 on bf16 passes);
-    fallback shapes keep the exact XLA recompute.
+    VMEM-resident scores on TPU via Pallas (interpret mode elsewhere).
+    Non-block-multiple S (ViT's 196, ragged text) pads up to the 128
+    grid with kv_valid masking while the padded work stays within 1.5x
+    of the true length (`_padded_len`); beyond that, and for head dim
+    < 64 (lane padding wastes the MXU), the XLA composition runs
+    instead — `kernel_ok(q)` is the public predicate.  Scale uses the
+    TRUE head dim even when D pads to the 128 lane.  Differentiable:
+    kernel-path shapes take the flash backward kernels (blockwise
+    recompute from the saved logsumexp — matches the XLA gradients to
+    MXU precision, ~1e-3 on bf16 passes); fallback shapes keep the
+    exact XLA recompute.
     """
     return _fused_attention_fwd(q, k, v, causal)[0]
 
@@ -393,14 +424,24 @@ def _from_bhsd(x, b, s, h, d):
     return x[..., :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+def _pad_seq(x, s_p):
+    s = x.shape[1]
+    if s_p == s:
+        return x
+    return jnp.pad(x, ((0, 0), (0, s_p - s), (0, 0)))
+
+
 def _run_kernel(q, k, v, causal: bool):
     b, s, h, d = q.shape
     d_p = _pad_up(d, _LANE)
-    o, lse = _attention_pallas(_to_bhsd(q, d_p), _to_bhsd(k, d_p),
-                               _to_bhsd(v, d_p), causal,
-                               1.0 / float(d) ** 0.5)
+    s_p = _padded_len(s)
+    kv_valid = s if s_p != s else None
+    o, lse = _attention_pallas(
+        _pad_seq(_to_bhsd(q, d_p), s_p), _pad_seq(_to_bhsd(k, d_p), s_p),
+        _pad_seq(_to_bhsd(v, d_p), s_p), causal,
+        1.0 / float(d) ** 0.5, kv_valid)
     # keep one lane of the broadcast lse as the backward residual
-    return _from_bhsd(o, b, s, h, d), lse[..., 0]
+    return _from_bhsd(o[:, :s], b, s, h, d), lse[:, :s, 0]
 
 
 def _fused_attention_fwd(q, k, v, causal):
@@ -420,21 +461,28 @@ def _fused_attention_bwd(causal, res, g):
         return vjp(g)
     b, s, h, d = q.shape
     d_p = _pad_up(d, _LANE)
+    s_p = _padded_len(s)
+    kv_valid = s if s_p != s else None
     scale = 1.0 / float(d) ** 0.5
-    # delta = rowsum(dO * O) on the TRUE head dim (pad columns are zero)
+    # delta = rowsum(dO * O) on the TRUE head dim (pad columns are zero).
+    # Padded Q rows are inert by construction: their dO rows pad to zero,
+    # so every dv/dk contribution they touch is zero; lse/delta pad 0.
     delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32), out)
-    delta = jnp.broadcast_to(delta.reshape(b * h, s)[..., None],
-                             (b * h, s, _LANE))
-    lse = jnp.broadcast_to(lse[..., None], (b * h, s, _LANE))
+    delta = _pad_seq(delta.reshape(b * h, s)[..., None], s_p)
+    delta = jnp.broadcast_to(delta, (b * h, s_p, _LANE))
+    lse = jnp.broadcast_to(_pad_seq(lse[..., None], s_p),
+                           (b * h, s_p, _LANE))
     # matmul-heavy backward runs at the inputs' dtype (bf16 on the MXU)
     # with f32 accumulation, like the forward
-    qp, kp, vp = (_to_bhsd(x, d_p) for x in (q, k, v))
-    dop = _to_bhsd(g.astype(q.dtype), d_p)
-    dk, dv = _attention_bwd_dkdv(qp, kp, vp, dop, lse, delta, causal, scale)
-    dq = _attention_bwd_dq(qp, kp, vp, dop, lse, delta, causal, scale)
-    return (_from_bhsd(dq, b, s, h, d).astype(q.dtype),
-            _from_bhsd(dk, b, s, h, d).astype(k.dtype),
-            _from_bhsd(dv, b, s, h, d).astype(v.dtype))
+    qp, kp, vp = (_pad_seq(_to_bhsd(x, d_p), s_p) for x in (q, k, v))
+    dop = _pad_seq(_to_bhsd(g.astype(q.dtype), d_p), s_p)
+    dk, dv = _attention_bwd_dkdv(qp, kp, vp, dop, lse, delta, causal,
+                                 scale, kv_valid)
+    dq = _attention_bwd_dq(qp, kp, vp, dop, lse, delta, causal,
+                           scale, kv_valid)
+    return (_from_bhsd(dq[:, :s], b, s, h, d).astype(q.dtype),
+            _from_bhsd(dk[:, :s], b, s, h, d).astype(k.dtype),
+            _from_bhsd(dv[:, :s], b, s, h, d).astype(v.dtype))
 
 
 fused_attention.defvjp(_fused_attention_fwd, _fused_attention_bwd)

@@ -101,6 +101,34 @@ def test_grad_multiblock_matches_xla(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), **GRAD_TOL)
 
 
+@pytest.mark.parametrize("seq,causal", [(196, False), (200, True)])
+def test_padded_seq_parity(seq, causal):
+    """Non-block-multiple S pads up to the 128 grid with kv_valid
+    masking (ViT's S=196 is the flagship case): forward AND gradients
+    must match dense exactly — zero-padded K rows must not steal
+    softmax mass, and padded Q rows must stay inert in the backward."""
+    from mmlspark_tpu.ops import attention_kernels as ak
+
+    rng = np.random.default_rng(11)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, seq, 2, 64)), jnp.float32)
+               for _ in range(3))
+    assert ak.kernel_ok(q), "padded path must take the kernel"
+    got = fused_attention(q, k, v, causal)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **F32_TOL)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, causal) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **GRAD_TOL)
+
+
 def test_unkernelable_shapes_fall_back_to_xla():
     """Shapes the kernel can't take must route to the XLA branch — and
     that branch must actually RUN (not just the predicate)."""

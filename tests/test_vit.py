@@ -120,3 +120,25 @@ def test_vit_moe_variant_trains(rng):
         state, ms = epoch(state, jax.device_put(imgs, sh),
                           jax.device_put(lbls, sh))
         assert np.all(np.isfinite(np.asarray(ms["loss"])))
+
+
+def test_vit_kernel_dispatch_matches_dense(monkeypatch):
+    """ViT's single-TPU branch routes attention through the flash kernel
+    pair with S padded 196->256 under kv_valid masking; forced on the
+    CPU backend (interpret mode), logits must match the dense-attention
+    model (same transformer-dispatch contract as TransformerLM)."""
+    from mmlspark_tpu.models import transformer as T
+    from mmlspark_tpu.models import vit as V
+    from mmlspark_tpu.models.vit import VisionTransformer
+
+    model = VisionTransformer(patch_size=16, embed_dim=128, num_layers=1,
+                              num_heads=2, num_classes=5,
+                              dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 224, 224, 3)),
+                    jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+    ref, _ = model.apply(variables, x)                      # dense path
+    monkeypatch.setattr(T, "_single_tpu", lambda: True)     # kernel path
+    got, _ = model.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)

@@ -142,21 +142,28 @@ def attn_child() -> int:
 
     rng = np.random.default_rng(0)
     failures = 0
-    points = [(1024, 64, 12), (2048, 128, 8), (4096, 128, 8)]
+    # (196, 64, 12, non-causal) is the ViT-B shape AS ViT RUNS IT: S pads
+    # 196->256 under kv_valid masking, bidirectional attention — the point
+    # measures whether the padded kernel beats XLA dense on the one
+    # production shape that needs padding, with the mask ViT exercises
+    points = [(196, 64, 12, False), (1024, 64, 12, True),
+              (2048, 128, 8, True), (4096, 128, 8, True)]
     if os.environ.get("ATTN_SWEEP_POINTS"):  # smoke override: "256:64:2,..."
-        points = [tuple(int(x) for x in p.split(":"))
+        points = [tuple(int(x) for x in p.split(":")) + (True,)
                   for p in os.environ["ATTN_SWEEP_POINTS"].split(",")]
-    for s, d, h in points:
+    for s, d, h, causal in points:
         q, k, v = (jnp.asarray(rng.normal(size=(4, s, h, d)), jnp.bfloat16)
                    for _ in range(3))
-        fns = {"pallas": jax.jit(lambda q, k, v: fused_attention(q, k, v, True)),
-               "xla": jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))}
+        fns = {"pallas": jax.jit(
+                   lambda q, k, v: fused_attention(q, k, v, causal)),
+               "xla": jax.jit(
+                   lambda q, k, v: full_attention(q, k, v, causal=causal))}
         # record which path 'pallas' ACTUALLY takes — parity of an XLA
         # fallback against XLA proves nothing about the Mosaic kernel
         kernel_runs = bool(ak.kernel_ok(q))
         rec = {**({"smoke": True} if os.environ.get("ATTN_SWEEP_POINTS")
                   else {}),
-               "seq": s, "head_dim": d, "heads": h,
+               "seq": s, "head_dim": d, "heads": h, "causal": causal,
                "backend": backend,
                "pallas_path": ("mosaic" if kernel_runs and backend == "tpu"
                                else "interpret" if kernel_runs
@@ -184,9 +191,10 @@ def attn_child() -> int:
             # OOM territory on one chip) and record kernel timing alone.
             if kernel_runs:
                 loss_k = lambda q, k, v: jnp.sum(
-                    fused_attention(q, k, v, True).astype(jnp.float32) ** 2)
+                    fused_attention(q, k, v, causal).astype(
+                        jnp.float32) ** 2)
                 loss_x = lambda q, k, v: jnp.sum(
-                    full_attention(q, k, v, causal=True).astype(
+                    full_attention(q, k, v, causal=causal).astype(
                         jnp.float32) ** 2)
                 gfn = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))
                 rec["bwd_pallas_ms"] = round(
