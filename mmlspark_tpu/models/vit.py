@@ -26,7 +26,7 @@ from typing import Any, Dict, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .transformer import _Block
+from .transformer import _Block, default_attn
 
 __all__ = ["VisionTransformer", "vit_tiny", "vit_small", "vit_base"]
 
@@ -73,7 +73,6 @@ class VisionTransformer(nn.Module):
         # shared dispatch rule with TransformerLM (transformer.default_attn):
         # flash kernel pair on a single TPU — S=196 pads to the 256 grid
         # with kv_valid masking — XLA dense under GSPMD sharding
-        from .transformer import default_attn
         attn = default_attn(False)
         from ..ops.quant import dense_cls
         for i in range(self.num_layers):

@@ -25,7 +25,7 @@ SESSION = os.path.join(REPO, "tools", "chip_session.sh")
 MODES = {
     # mode-flag -> (extra env, min JSON lines expected on stdout)
     "--quick": ({"MFU_SWEEP_SMOKE": "1"}, 6),
-    "--attn": ({"ATTN_SWEEP_POINTS": "128:64:2"}, 1),
+    "--attn": ({"ATTN_SWEEP_POINTS": "128:64:2,196:64:2:0"}, 2),
     "--decode": ({"MFU_SWEEP_SMOKE": "1", "DECODE_SWEEP_SMALL": "1"}, 1),
     "--batcher": ({"DECODE_SWEEP_SMALL": "1"}, 1),
     "--serving": ({"SERVING_SWEEP_SMALL": "1"}, 1),
@@ -170,3 +170,23 @@ def test_roofline_modes_emit_json():
                 rec["decode_tok_per_sec_ceiling_f32"]
         if model == "all":
             assert len(recs) == 4
+
+
+def test_lm_ablate_smoke_emits_json():
+    """tools/lm_ablate.py is the LM-step perf-forensics tool (it found
+    the 71%-of-step attention backward); its smoke mode must keep the
+    whole path — model build, scanned epoch, fetch-blocked timing, JSON
+    shape — runnable on CPU so API drift can't burn a tunnel window."""
+    tool = os.path.join(REPO, "tools", "lm_ablate.py")
+    env = dict(os.environ, LM_ABLATE_SMOKE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, tool], capture_output=True,
+                          text=True, timeout=600, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    recs = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    assert len(recs) == 4, recs
+    tags = {r["tag"] for r in recs}
+    assert {"baseline_b16", "fwd_only_b16", "xla_attn_b16", "b32"} == tags
+    for rec in recs:
+        assert rec["smoke"] is True
+        assert rec["ms_per_step"] > 0

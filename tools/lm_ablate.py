@@ -17,6 +17,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # the axon sitecustomize pre-registers the TPU backend and wins the
+    # race against the env var alone — same pin as mfu_sweep/_conftest
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import optax
 
@@ -40,11 +46,23 @@ def _time_epoch(run_fetch, reps=3):
 
 
 def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False):
-    model = transformer_lm(vocab_size=8192, embed_dim=768, num_layers=12,
-                           num_heads=12, max_len=seq, dtype=jnp.bfloat16,
-                           attn_fn=attn_fn)
+    smoke = bool(os.environ.get("LM_ABLATE_SMOKE"))
+    if smoke:
+        # CPU contract smoke (tests/test_sweep_contract.py): the same
+        # code path — model build, scanned epoch, fetch-blocked timing,
+        # JSON shape — at a size the CPU backend can turn around (batch
+        # 8 divides the virtual 8-device data mesh the test env pins)
+        batch, seq, steps = 8, 128, 2
+        model = transformer_lm(vocab_size=64, embed_dim=64, num_layers=1,
+                               num_heads=1, max_len=seq,
+                               dtype=jnp.float32, attn_fn=attn_fn)
+    else:
+        model = transformer_lm(vocab_size=8192, embed_dim=768,
+                               num_layers=12, num_heads=12, max_len=seq,
+                               dtype=jnp.bfloat16, attn_fn=attn_fn)
+    vocab = 64 if smoke else 8192
     rng = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(rng, (steps, batch, seq), 0, 8192, jnp.int32)
+    tokens = jax.random.randint(rng, (steps, batch, seq), 0, vocab, jnp.int32)
     params = jax.jit(lambda r, t: model.init(r, t)["params"])(rng, tokens[0])
     if fwd_only:
         def fwd_epoch(params, tokens):
@@ -71,6 +89,7 @@ def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False):
         run = lambda: np.asarray(compiled(params, opt_state, tokens)[2])
     best = _time_epoch(run)
     print(json.dumps({
+        **({"smoke": True} if smoke else {}),
         "tag": tag,
         "tokens_per_sec": round(steps * batch * seq / best, 0),
         "mfu": (round(steps * flops_step / best / peak_flops(), 4)

@@ -148,8 +148,14 @@ def attn_child() -> int:
     # production shape that needs padding, with the mask ViT exercises
     points = [(196, 64, 12, False), (1024, 64, 12, True),
               (2048, 128, 8, True), (4096, 128, 8, True)]
-    if os.environ.get("ATTN_SWEEP_POINTS"):  # smoke override: "256:64:2,..."
-        points = [tuple(int(x) for x in p.split(":")) + (True,)
+    if os.environ.get("ATTN_SWEEP_POINTS"):
+        # smoke override: "s:d:h" (causal) or "s:d:h:0" (non-causal) —
+        # the 4th field lets smoke cover the kv_valid/bidirectional branch
+        def _parse(p):
+            f = p.split(":")
+            return (int(f[0]), int(f[1]), int(f[2]),
+                    bool(int(f[3])) if len(f) > 3 else True)
+        points = [_parse(p)
                   for p in os.environ["ATTN_SWEEP_POINTS"].split(",")]
     for s, d, h, causal in points:
         q, k, v = (jnp.asarray(rng.normal(size=(4, s, h, d)), jnp.bfloat16)
