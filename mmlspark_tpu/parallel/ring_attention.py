@@ -91,15 +91,13 @@ def _resolve_axis(mesh: Mesh, axis: Optional[str]) -> str:
     return "data"
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,
-                   causal: bool = False):
-    """Exact attention with sequence sharded over `axis` (default: the
-    mesh's 'seq' axis if populated, else 'data').
-
-    q,k,v: (B, S, H, D) GLOBAL arrays (or already sharded); S must divide by
-    the axis size.  Returns (B, S, H, D) with the same sharding.
-    """
-    axis = _resolve_axis(mesh, axis)
+def _ring_driver(q, k, v, mesh: Mesh, axis: str, accumulate):
+    """THE ring protocol, shared by the dense and flash paths: K/V blocks
+    rotate via ppermute for n-1 scan steps plus one unscanned final
+    block (no wasted last rotation); `accumulate(q_loc, k_blk, v_blk,
+    o, m, l, q_off, k_off)` folds one held block into the online-softmax
+    carry.  One copy of the offset/rotation math means a fix here fixes
+    both paths."""
     n = mesh.shape[axis]
     seq_spec = P(None, axis, None, None)
 
@@ -123,28 +121,130 @@ def ring_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,
         def step(carry, r):
             o, m, l, k_blk, v_blk = carry
             # k/v block currently held came from device (idx - r) mod n
-            src = (idx - r) % n
-            k_off = src * s_loc
-            o, m, l = _block_accumulate(
-                q_loc, k_blk, v_blk, o, m, l, q_off, k_off, causal
-            )
+            k_off = ((idx - r) % n) * s_loc
+            o, m, l = accumulate(q_loc, k_blk, v_blk, o, m, l, q_off, k_off)
             # rotate: send our block to the next device in the ring
             k_nxt = jax.lax.ppermute(k_blk, axis, perm)
             v_nxt = jax.lax.ppermute(v_blk, axis, perm)
             return (o, m, l, k_nxt, v_nxt), None
 
-        # n-1 rotations; the last held block is accumulated without a
-        # wasted final ppermute of the full K/V shard
         (o, m, l, k_last, v_last), _ = jax.lax.scan(
             step, (o, m, l, k_loc, v_loc), jnp.arange(n - 1)
         )
-        o, m, l = _block_accumulate(
-            q_loc, k_last, v_last, o, m, l, q_off,
-            ((idx - (n - 1)) % n) * s_loc, causal,
-        )
+        o, m, l = accumulate(q_loc, k_last, v_last, o, m, l, q_off,
+                             ((idx - (n - 1)) % n) * s_loc)
         return o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
 
     return ring(q, k, v)
+
+
+def _ring_dense(q, k, v, mesh: Mesh, axis: str, causal: bool):
+    """The dense-block ring: per-step [Sq, Sk] score blocks in XLA."""
+
+    def accumulate(q_loc, k_blk, v_blk, o, m, l, q_off, k_off):
+        return _block_accumulate(q_loc, k_blk, v_blk, o, m, l,
+                                 q_off, k_off, causal)
+
+    return _ring_driver(q, k, v, mesh, axis, accumulate)
+
+
+def _merge_normalized(o, m, l, o_b, lse_b):
+    """Fold one NORMALIZED attention block (o_b, lse_b) into the running
+    (o, m, l) online-softmax carry.  A normalized block is a weighted
+    value with scalar log-weight lse_b per row — the same (reference,
+    weight, weighted-values) algebra _block_accumulate maintains, so
+    dense and flash steps can mix freely."""
+    m_new = jnp.maximum(m, lse_b)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    w = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - m_safe), 0.0)
+    o_new = (o * corr.transpose(0, 2, 1)[..., None]
+             + o_b * w.transpose(0, 2, 1)[..., None])
+    return o_new, m_new, l * corr + w
+
+
+def _ring_flash_fwd(q, k, v, mesh: Mesh, axis: str, causal: bool):
+    """Ring forward with each block's attention in the Pallas flash
+    kernel (VMEM-resident scores; the kernel's lse output is exactly the
+    per-block merge statistic) — Liu et al.'s construction with the
+    intra-block part on the MXU instead of dense XLA.  Causality between
+    BLOCKS is static per relation (behind/diagonal/ahead) but the
+    relation itself depends on the device index, so the three cases ride
+    lax.cond."""
+    from ..ops.attention_kernels import _run_kernel
+
+    def accumulate(q_loc, k_blk, v_blk, o, m, l, q_off, k_off):
+        b, s_loc, h, _ = q_loc.shape
+
+        def run(blk_causal):
+            o_b, lse = _run_kernel(q_loc, k_blk, v_blk, blk_causal)
+            return o_b, lse.reshape(b, h, s_loc)
+
+        def skipped():
+            return (jnp.zeros(q_loc.shape, jnp.float32),
+                    jnp.full((b, h, s_loc), -jnp.inf, jnp.float32))
+
+        if not causal:
+            o_b, lse = run(False)
+        else:
+            # k block strictly behind the queries -> fully visible;
+            # same offset -> the kernel's own causal mask IS the global
+            # mask (blocks are equal-sized and aligned); ahead -> skip
+            o_b, lse = jax.lax.cond(
+                k_off < q_off, lambda: run(False),
+                lambda: jax.lax.cond(k_off == q_off,
+                                     lambda: run(True), skipped))
+        return _merge_normalized(o, m, l, o_b, lse)
+
+    return _ring_driver(q, k, v, mesh, axis, accumulate)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, mesh, axis, causal):
+    """Flash-forward ring with the dense-ring recompute as backward —
+    forward traffic drops to the flash shape while gradients stay the
+    exact dense-block autodiff (same containment stance as the fused
+    kernel took before its flash backward landed)."""
+    return _ring_flash_fwd(q, k, v, mesh, axis, causal)
+
+
+def _ring_flash_f(q, k, v, mesh, axis, causal):
+    return _ring_flash_fwd(q, k, v, mesh, axis, causal), (q, k, v)
+
+
+def _ring_flash_b(mesh, axis, causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _ring_dense(q, k, v, mesh, axis, causal), q, k, v)
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_f, _ring_flash_b)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,
+                   causal: bool = False):
+    """Exact attention with sequence sharded over `axis` (default: the
+    mesh's 'seq' axis if populated, else 'data').
+
+    q,k,v: (B, S, H, D) GLOBAL arrays (or already sharded); S must divide by
+    the axis size.  Returns (B, S, H, D) with the same sharding.
+
+    When the LOCAL block shape can take the Pallas kernel, each ring
+    step's intra-block attention runs VMEM-resident (flash) and blocks
+    merge by their logsumexp; otherwise the dense-block path runs.  Both
+    are exact vs full attention (tests assert it).
+    """
+    from ..ops.attention_kernels import kernel_ok
+
+    axis = _resolve_axis(mesh, axis)
+    n = mesh.shape[axis]
+    blk = q.shape[1] // n
+    local = jax.ShapeDtypeStruct((q.shape[0], blk, q.shape[2], q.shape[3]),
+                                 q.dtype)
+    if kernel_ok(local):
+        return _ring_flash(q, k, v, mesh, axis, causal)
+    return _ring_dense(q, k, v, mesh, axis, causal)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,

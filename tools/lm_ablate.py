@@ -52,15 +52,15 @@ def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False):
         # code path — model build, scanned epoch, fetch-blocked timing,
         # JSON shape — at a size the CPU backend can turn around (batch
         # 8 divides the virtual 8-device data mesh the test env pins)
-        batch, seq, steps = 8, 128, 2
-        model = transformer_lm(vocab_size=64, embed_dim=64, num_layers=1,
-                               num_heads=1, max_len=seq,
+        batch, seq, steps, vocab = 8, 128, 2, 64
+        model = transformer_lm(vocab_size=vocab, embed_dim=64,
+                               num_layers=1, num_heads=1, max_len=seq,
                                dtype=jnp.float32, attn_fn=attn_fn)
     else:
-        model = transformer_lm(vocab_size=8192, embed_dim=768,
+        vocab = 8192
+        model = transformer_lm(vocab_size=vocab, embed_dim=768,
                                num_layers=12, num_heads=12, max_len=seq,
                                dtype=jnp.bfloat16, attn_fn=attn_fn)
-    vocab = 64 if smoke else 8192
     rng = jax.random.PRNGKey(0)
     tokens = jax.random.randint(rng, (steps, batch, seq), 0, vocab, jnp.int32)
     params = jax.jit(lambda r, t: model.init(r, t)["params"])(rng, tokens[0])
