@@ -173,38 +173,38 @@ class ImageFeaturizer(Transformer):
         model = self._model_for(bundle, self.input_col)
         dev_vars, jitted, mesh = model._executor(
             bundle, model._fetch_name(bundle))
-        dp = mesh.shape["data"]
+        # `failed` is appended by build_chunk on the prefetch thread and read
+        # only after run_grouped returns (the producer is exhausted by then)
         failed: List[int] = []  # rows whose pixel decode failed every way
         results: List[Any] = [None] * n
 
-        for (gh, gw, gc), idxs in groups.items():
-            bs, pad_mult = model.chunk_sizes(len(idxs), dp)
+        # All shape groups feed through ONE bounded in-flight window
+        # (TPUModel.run_grouped) so the transfer/compute overlap never drains
+        # at a group boundary; native JPEG decode fills each chunk buffer on
+        # the prefetch thread, overlapped with device compute.
 
-            def chunks(idxs=idxs, gh=gh, gw=gw, gc=gc, bs=bs,
-                       pad_mult=pad_mult):
-                for start in range(0, len(idxs), bs):
-                    sel = idxs[start:start + bs]
-                    k = -(-len(sel) // pad_mult) * pad_mult
-                    buf = np.zeros((k, gh, gw, gc), np.uint8)
-                    for j, i in enumerate(sel):
-                        if i in decoded:
-                            buf[j] = decoded[i]
-                        elif not native.decode_jpeg_bgr_into(
-                                bytes(col[i]), buf[j]):
-                            # libjpeg rejected it (CMYK/YCCK, truncation):
-                            # PIL-fallback like decode_image before dropping
-                            row = safe_read(bytes(col[i]))
-                            arr = (image_row_to_array(row)
-                                   if row is not None else None)
-                            if arr is not None and arr.shape == (gh, gw, gc):
-                                buf[j] = arr
-                            else:
-                                failed.append(i)
-                    yield buf, len(sel)
+        def build_chunk(shape, sel):
+            gh, gw, gc = shape
+            buf = np.zeros((len(sel), gh, gw, gc), np.uint8)
+            for j, i in enumerate(sel):
+                if i in decoded:
+                    buf[j] = decoded[i]
+                elif not native.decode_jpeg_bgr_into(bytes(col[i]), buf[j]):
+                    # libjpeg rejected it (CMYK/YCCK, truncation):
+                    # PIL-fallback like decode_image before dropping
+                    row = safe_read(bytes(col[i]))
+                    arr = (image_row_to_array(row)
+                           if row is not None else None)
+                    if arr is not None and arr.shape == (gh, gw, gc):
+                        buf[j] = arr
+                    else:
+                        failed.append(i)
+            return buf
 
-            group_out = model.run_chunk_iter(chunks(), jitted, dev_vars, mesh)
-            for i, y in zip(idxs, group_out):
-                results[i] = np.asarray(y).reshape(-1)
+        feed_order, out_rows = model.run_grouped(
+            groups, build_chunk, jitted, dev_vars, mesh)
+        for i, y in zip(feed_order, out_rows):
+            results[i] = np.asarray(y).reshape(-1)
 
         bad = {i for i, s in enumerate(shapes) if s is None} | set(failed)
         if bad:

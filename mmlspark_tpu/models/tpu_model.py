@@ -238,18 +238,47 @@ class TPUModel(Transformer):
     # device compute of consecutive chunks overlap.
     _INFLIGHT = 3
 
+    def _stacking_builder(self, rows):
+        """build_chunk callable for run_grouped that stacks row arrays and
+        coerces to the configured feed dtype (shared by the flat row path
+        and the group_by_shape path so the coercion can't diverge)."""
+        dtype = _FEED_DTYPES[self.feed_dtype]
+        return lambda _shape, sel: np.stack(
+            [rows[i] for i in sel]).astype(dtype, copy=False)
+
     def _run_chunks(self, rows: List[np.ndarray], jitted, dev_vars, mesh) -> List[np.ndarray]:
         """Feed same-shape rows through the executor; returns per-row outputs."""
+        _order, out = self.run_grouped(
+            {None: list(range(len(rows)))}, self._stacking_builder(rows),
+            jitted, dev_vars, mesh)
+        return out  # single group: feed order == row order
+
+    def run_grouped(self, groups, build_chunk, jitted, dev_vars, mesh):
+        """Feed ordered shape groups through ONE bounded in-flight window and
+        return (feed_order, rows-in-feed-order).  Chunks of different shapes
+        interleave through the same pipeline (jax.jit caches one compiled
+        program per shape), so the transfer/compute overlap never drains at a
+        group boundary — through a high-latency link (the tunneled chip) each
+        drain is a full round-trip bubble per group.  The chunk plan is laid
+        out eagerly here, so chunk sizing/padding lives in exactly one place
+        for the row path and ImageFeaturizer's streaming byte path (the
+        chunk_sizes invariant), and the prefetch thread shares no mutable
+        state with the caller.  `build_chunk(shape, sel)` returns the stacked
+        [len(sel), ...] feed chunk for those row indices; it runs on the
+        prefetch thread so decode/assembly overlap device compute."""
         dp = mesh.shape["data"]
-        bs, pad_mult = self.chunk_sizes(len(rows), dp)
-        dtype = _FEED_DTYPES[self.feed_dtype]
+        plan = []  # (sel, shape, pad_mult) per chunk, in feed order
+        for shape, idxs in groups.items():
+            bs, pad_mult = self.chunk_sizes(len(idxs), dp)
+            for start in range(0, len(idxs), bs):
+                plan.append((idxs[start:start + bs], shape, pad_mult))
+        feed_order = [i for sel, _, _ in plan for i in sel]
 
-        def prep():
-            for start in range(0, len(rows), bs):
-                chunk = np.stack(rows[start:start + bs]).astype(dtype, copy=False)
-                yield pad_to_multiple(chunk, pad_mult, axis=0)
+        def chunks():
+            for sel, shape, pad_mult in plan:
+                yield pad_to_multiple(build_chunk(shape, sel), pad_mult, axis=0)
 
-        return self.run_chunk_iter(prep(), jitted, dev_vars, mesh)
+        return feed_order, self.run_chunk_iter(chunks(), jitted, dev_vars, mesh)
 
     def chunk_sizes(self, n_rows: int, dp: int):
         """(chunk_size, pad_multiple) for a group of n_rows: chunk size is
@@ -303,17 +332,18 @@ class TPUModel(Transformer):
         n = len(col)
         if self.group_by_shape:
             # ragged rows: one XLA program per distinct shape (recompile is
-            # per-shape, cached), rows scattered back to original order
+            # per-shape, cached), all groups through one in-flight window
+            # (run_grouped), rows scattered back to original order
             groups: "OrderedDict[tuple, List[int]]" = OrderedDict()
             arrays = [np.asarray(v) for v in col]
             for i, a in enumerate(arrays):
                 groups.setdefault(a.shape, []).append(i)
             cells: List[Any] = [None] * n
-            for _shape, idxs in groups.items():
-                group_out = self._run_chunks(
-                    [arrays[i] for i in idxs], jitted, dev_vars, mesh)
-                for i, y in zip(idxs, group_out):
-                    cells[i] = y
+            feed_order, out_rows = self.run_grouped(
+                groups, self._stacking_builder(arrays),
+                jitted, dev_vars, mesh)
+            for i, y in zip(feed_order, out_rows):
+                cells[i] = y
             result = np.stack(cells) if n else np.zeros((0,))
         else:
             batch_np = _gather_input(
