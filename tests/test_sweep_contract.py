@@ -131,8 +131,9 @@ def test_chip_session_stage_list_dryrun():
             if l.startswith("DRYRUN: ")]
     stages = [l.split()[1] for l in proc.stdout.splitlines()
               if l.startswith("== ") and "->" in l]
-    assert stages == ["bench", "attn-sweep", "mfu-sweep", "decode-sweep",
-                      "batcher-sweep", "serving-sweep", "tpu-tests"]
+    assert stages == ["bench", "attn-sweep", "lm-ablate", "mfu-sweep",
+                      "decode-sweep", "batcher-sweep", "serving-sweep",
+                      "tpu-tests"]
     help_text = subprocess.run(
         [sys.executable, SWEEP, "--help"], capture_output=True, text=True,
         timeout=60, cwd=REPO).stdout

@@ -42,6 +42,10 @@ run bench timeout 3300 python bench.py
 
 run attn-sweep timeout 1800 python tools/mfu_sweep.py --attn
 
+# 4 configs attributing the LM train step's MFU gap (fwd vs bwd, fused
+# vs XLA attention, batch scaling) — the round-5 perf frontier
+run lm-ablate timeout 2700 python tools/lm_ablate.py
+
 # 6 quick configs (resnet50 b128/256/512 + vit b128/256 + vit-int8) x 900s cap
 run mfu-sweep timeout 6300 python tools/mfu_sweep.py --quick
 
