@@ -45,7 +45,8 @@ def _time_epoch(run_fetch, reps=3):
     return best
 
 
-def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False):
+def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False,
+            num_heads=12):
     smoke = bool(os.environ.get("LM_ABLATE_SMOKE"))
     if smoke:
         # CPU contract smoke (tests/test_sweep_contract.py): the same
@@ -59,8 +60,9 @@ def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False):
     else:
         vocab = 8192
         model = transformer_lm(vocab_size=vocab, embed_dim=768,
-                               num_layers=12, num_heads=12, max_len=seq,
-                               dtype=jnp.bfloat16, attn_fn=attn_fn)
+                               num_layers=12, num_heads=num_heads,
+                               max_len=seq, dtype=jnp.bfloat16,
+                               attn_fn=attn_fn)
     rng = jax.random.PRNGKey(0)
     tokens = jax.random.randint(rng, (steps, batch, seq), 0, vocab, jnp.int32)
     params = jax.jit(lambda r, t: model.init(r, t)["params"])(rng, tokens[0])
@@ -105,6 +107,13 @@ def main():
     measure("fwd_only_b16", fwd_only=True)
     measure("xla_attn_b16", attn_fn=xla_attn)
     measure("b32", batch=32)
+    # attention as identity (v passthrough): the gap between this and
+    # baseline is the TOTAL attention cost (kernel + projections' fusion
+    # slack) — the model still type-checks because attn_fn sees [B,H,S,D]
+    measure("no_attn_b16", attn_fn=lambda q, k, v: v)
+    # same 768 width, 6 heads of d128: whether the d_head=64 shape (half
+    # the 128-lane register width) is what holds the fused kernel back
+    measure("h6_d128_b16", num_heads=6)
 
 
 if __name__ == "__main__":
