@@ -31,6 +31,48 @@ MIN_RATIO = 0.70
 
 @pytest.mark.skipif(not native.jpeg_available(),
                     reason="needs the native JPEG decoder (streaming path)")
+def test_mixed_shape_groups_share_one_feed_window():
+    """Shape-grouped input must flow through ONE bounded in-flight window
+    (TPUModel.run_grouped): with 3 JPEG shape groups the e2e throughput
+    has to stay within 2x of the single-shape streaming path on the same
+    pixel count — a per-group pipeline drain (the pre-round-5 behavior)
+    shows up here as 3 serial pipelines plus per-group warm-up bubbles."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+
+    def jpeg(h, w):
+        buf = io.BytesIO()
+        Image.fromarray(rng.integers(0, 256, (h, w, 3), np.uint8)).save(
+            buf, format="JPEG", quality=85)
+        return buf.getvalue()
+
+    mixed = Table({"image": [jpeg(*[(128, 128), (144, 128), (128, 160)][i % 3])
+                             for i in range(48)]})
+    mono = Table({"image": [jpeg(128, 128) for _ in range(48)]})
+    bundle = FlaxBundle("resnet18", {"num_classes": 10, "dtype": jnp.float32},
+                        input_shape=(112, 112, 3), seed=0)
+    feat = ImageFeaturizer(bundle=bundle, input_col="image",
+                           output_col="features", batch_size=16)
+    for t in (mixed, mono):
+        feat.transform(t)  # warm: compile every shape group's program
+    times = {}
+    for name, t in (("mixed", mixed), ("mono", mono)):
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            feat.transform(t)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        times[name] = best
+    ratio = times["mixed"] / times["mono"]
+    assert ratio < 2.0, (
+        f"mixed-shape e2e is {ratio:.2f}x the single-shape time — "
+        "the shape groups are not sharing one feed window")
+
+
+@pytest.mark.skipif(not native.jpeg_available(),
+                    reason="needs the native JPEG decoder (streaming path)")
 def test_e2e_feed_at_least_70pct_of_forward_only():
     import jax
     import jax.numpy as jnp
