@@ -9,7 +9,10 @@ sibling; reference has no analog — its deepest attention is CNTK-era).
 
 Mosaic-friendly formulation (same playbook as pallas_kernels.py):
   - Q/K/V reshaped OUTSIDE the kernel to [B*H, S, D] (no in-kernel
-    reshapes), head_dim padded to a 128 multiple (lane tiling).
+    reshapes); head_dim runs NATIVE at 128-multiples and (probe-gated,
+    see _native_d64_ok) at 64-mod-128 dims — padding d=64 up to the
+    lane would double the QK^T MACs with zeros and materialize 2x-size
+    q/k/v/o copies around every call; other dims pad to the 128 lane.
   - grid = (B*H, S/block_q, S/block_k), K innermost: K/V blocks STREAM
     through VMEM while running max / normalizer / unnormalized output
     live in VMEM scratch across the K steps (online softmax, the true
@@ -431,9 +434,55 @@ def _pad_seq(x, s_p):
     return jnp.pad(x, ((0, 0), (0, s_p - s), (0, 0)))
 
 
+_NATIVE_D64_OK = None
+
+
+def _native_d64_ok() -> bool:
+    """Can the kernels run with a 64-lane head dim natively (no pad to
+    128)?  The padded path doubles the QK^T contraction's MAC count with
+    zeros AND materializes 2x-size copies of q/k/v/o around every call —
+    for d_head=64 models (the LM and ViT-B flagship shapes) that is pure
+    waste when Mosaic takes the 64-minor tiles.  Probed ONCE per process
+    by compiling all three kernels on a tiny shape; a Mosaic rejection
+    self-heals to the padded path, so this can never cost a bench run."""
+    global _NATIVE_D64_OK
+    if _NATIVE_D64_OK is None:
+        if _interpret():
+            _NATIVE_D64_OK = True  # interpret mode has no tiling rules
+        else:
+            try:
+                import numpy as _np
+
+                z = jnp.asarray(_np.zeros((1, 128, 64), _np.float32))
+                st = jnp.zeros((1, 128, _LANE), jnp.float32)
+                o, lse = _attention_pallas(z, z, z, True, 0.125, None)
+                jax.block_until_ready(
+                    _attention_bwd_dkdv(z, z, z, z, st, st, True, 0.125,
+                                        None))
+                jax.block_until_ready(
+                    _attention_bwd_dq(z, z, z, z, st, st, True, 0.125,
+                                      None))
+                jax.block_until_ready(o)
+                _NATIVE_D64_OK = True
+            except Exception:  # noqa: BLE001 — any compile/run rejection
+                _NATIVE_D64_OK = False
+    return _NATIVE_D64_OK
+
+
+def _kernel_d(d: int) -> int:
+    """Head-dim the kernels run at: lane-multiple dims are native; the
+    64-mod-128 dims (64, 192, ...) stay native when the probe passes;
+    everything else pads up to the 128 lane."""
+    if d % _LANE == 0:
+        return d
+    if d % 64 == 0 and _native_d64_ok():
+        return d
+    return _pad_up(d, _LANE)
+
+
 def _run_kernel(q, k, v, causal: bool):
     b, s, h, d = q.shape
-    d_p = _pad_up(d, _LANE)
+    d_p = _kernel_d(d)
     s_p = _padded_len(s)
     kv_valid = s if s_p != s else None
     o, lse = _attention_pallas(
@@ -460,7 +509,7 @@ def _fused_attention_bwd(causal, res, g):
                          q, k, v)
         return vjp(g)
     b, s, h, d = q.shape
-    d_p = _pad_up(d, _LANE)
+    d_p = _kernel_d(d)  # same decision as _run_kernel (cached probe)
     s_p = _padded_len(s)
     kv_valid = s if s_p != s else None
     scale = 1.0 / float(d) ** 0.5

@@ -174,6 +174,9 @@ def attn_child() -> int:
                "pallas_path": ("mosaic" if kernel_runs and backend == "tpu"
                                else "interpret" if kernel_runs
                                else "xla-fallback"),
+               # the head-dim the kernels actually tile at: d means the
+               # native 64-lane path is active, 128 means the padded one
+               "kernel_d": (ak._kernel_d(d) if kernel_runs else None),
                # set ONLY after the kernel actually compiled, ran, and
                # matched — a thrown compile must not read as validated
                "mosaic_validated": False}
