@@ -350,6 +350,9 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
                                output_col="features", batch_size=E2E_BATCH,
                                use_pallas=False)
         feat.transform(table)
+    from mmlspark_tpu.io.feed import FEED_TELEMETRY, FeedTelemetry
+
+    feed_since = FEED_TELEMETRY.snapshot()
     e2e_dt = None
     for _ in range(3):  # tunneled-chip timings are noisy: best of 3
         t0 = time.perf_counter()
@@ -358,11 +361,20 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
         e2e_dt = dt if e2e_dt is None else min(e2e_dt, dt)
     assert out_table["features"].shape[0] == e2e_n
     e2e_ips = e2e_n / e2e_dt
+    # the DeviceFeed engine's own counters over the timed transforms:
+    # achieved wire bandwidth, the fraction of feed wall time hidden
+    # under device compute, and the host-side stall budget — these are
+    # what distinguish "the link is slow" from "the feed is serializing"
+    feed = FeedTelemetry.summarize(FEED_TELEMETRY.delta(feed_since))
 
     out = {
         "value": round(e2e_ips, 1),
         "forward_ips": round(forward_ips, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "overlap_frac": feed["overlap_frac"],
+        "stall_s": feed["stall_s"],
+        "feed_gbps": feed["h2d_gbps"],
+        "feed_transfer_calls": feed["transfer_calls"],
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
     }
@@ -530,6 +542,8 @@ def main():
         "forward_ips": res["forward_ips"],
         "mfu": res["mfu"],
         **{k: res[k] for k in ("decode_ips", "h2d_gbps", "h2d_ips",
+                               "overlap_frac", "stall_s", "feed_gbps",
+                               "feed_transfer_calls",
                                "e2e_bound", "bottleneck_error",
                                "pallas_fallback") if k in res},
         "cifar10_train_samples_per_sec": train.get("train_samples_per_sec"),

@@ -52,7 +52,7 @@ params = model.init({"params": jax.random.PRNGKey(0)}, tokens[0],
 opt = optax.adam(3e-3)
 opt_state = opt.init(params)
 epoch = make_lm_train_epoch(model, opt, donate=False)
-for e in range(6 if FAST else 20):
+for e in range(12 if FAST else 20):
     params, opt_state, losses = epoch(params, opt_state, tokens)
 print(f"final next-token loss: {float(losses[-1]):.4f}")
 

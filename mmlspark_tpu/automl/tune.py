@@ -110,7 +110,20 @@ class TuneHyperparameters(Estimator):
                 )
             return float(np.mean(vals))
 
-        with ThreadPoolExecutor(max_workers=int(self.parallelism)) as pool:
+        import jax
+
+        par = int(self.parallelism)
+        if par > 1 and jax.default_backend() == "cpu" \
+                and jax.device_count() > 1:
+            # XLA:CPU runs multi-device collectives through an in-process
+            # rendezvous: two concurrently dispatched sharded programs
+            # interleave their per-device partitions on the shared intra-op
+            # pool and deadlock waiting for each other's participants
+            # (observed with two concurrent GBDT trials on the 8-device
+            # virtual mesh).  Real chips serialize programs in the runtime,
+            # so only the virtual-mesh CPU backend needs the guard.
+            par = 1
+        with ThreadPoolExecutor(max_workers=par) as pool:
             metrics = list(pool.map(run_trial, candidates))
 
         best_i = _select_best(metrics, larger)

@@ -246,7 +246,7 @@ class HistogramBuilder(RowShardedBuilderBase):
 
     def _make_sharded(self, mesh, axis):
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from ..parallel.mesh import shard_map
 
         num_bins = self.num_bins
 
@@ -278,7 +278,7 @@ class HistogramBuilder(RowShardedBuilderBase):
 
     def _make_sharded_local(self, mesh, axis):
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from ..parallel.mesh import shard_map
 
         num_bins = self.num_bins
 

@@ -449,7 +449,7 @@ class SparseHistogramBuilder(RowShardedBuilderBase):
 
     def _make_sharded(self, mesh, axis, local: bool):
         import jax
-        from jax import shard_map
+        from ..parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
 
         num_bins, num_features = self.num_bins, self.f

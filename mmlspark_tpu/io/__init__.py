@@ -1,0 +1,1 @@
+from .feed import DeviceFeed, FeedTelemetry, FEED_TELEMETRY, default_depth

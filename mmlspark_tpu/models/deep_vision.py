@@ -193,6 +193,13 @@ class DeepVisionClassifier(Estimator):
                               NamedSharding(mesh, P(None, "data"))),
                 donate_argnums=(0,))
             sh = NamedSharding(mesh, P(None, "data"))
+            from ..io.feed import DeviceFeed
+
+            # one feed for the whole fit: slice t+1's host->device transfer
+            # rides the DeviceFeed (packed single transfer on one device,
+            # prefetched `depth` ahead) while slice t's scanned epoch
+            # computes — the per-slice device_put stall disappears
+            feed = DeviceFeed(mesh=mesh)
             history = []
             # the shuffle stream must be reproducible across a resume:
             # replay the epochs already consumed
@@ -213,10 +220,10 @@ class DeepVisionClassifier(Estimator):
                     [y[order], np.full(pad, -1, np.int32)]
                 ).reshape(n_steps, bs)
                 losses = []
-                for s in range(0, n_steps, k):
-                    state, ls = epoch(state,
-                                      jax.device_put(xb[s : s + k], sh),
-                                      jax.device_put(yb[s : s + k], sh))
+                slices = ((xb[s : s + k], yb[s : s + k])
+                          for s in range(0, n_steps, k))
+                for dxb, dyb in feed.stream(slices, shardings=(sh, sh)):
+                    state, ls = epoch(state, dxb, dyb)
                     losses.append(np.asarray(ls))
                 history.append(float(np.mean(np.concatenate(losses))))
                 if ckpt is not None:

@@ -50,6 +50,10 @@ class ImageFeaturizer(Transformer):
         "pad every device chunk to the full batch_size (one compiled shape "
         "forever — the serving setting; see TPUModel.pad_to_batch)",
         default=False, converter=TypeConverters.to_bool)
+    feed_depth = Param(
+        "host->device pipeline depth (DeviceFeed transfer groups in "
+        "flight; see TPUModel.feed_depth)",
+        default=2, converter=TypeConverters.to_int)
 
     def __init__(self, bundle: Optional[ModelBundle] = None, **kw):
         super().__init__(**kw)
@@ -83,6 +87,7 @@ class ImageFeaturizer(Transformer):
             group_by_shape=True,
             feed_dtype="uint8",
             pad_to_batch=self.pad_to_batch,
+            feed_depth=self.feed_depth,
         )
 
     def _transform(self, table: Table) -> Table:

@@ -19,7 +19,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.batching import buffered_prefetch
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
 from ..core.registry import register_stage
@@ -110,14 +109,10 @@ class ImagePreprocess:
                 # device runs the Mosaic program on its local [B/dp,...]
                 # block — no cross-device deps, so no collectives appear
                 spec = batch_sharding(mesh, batch.ndim).spec
-                try:
-                    from jax import shard_map
-                    wrapped = shard_map(fused, mesh=mesh, in_specs=(spec,),
-                                        out_specs=spec, check_vma=False)
-                except (ImportError, TypeError):  # older jax
-                    from jax.experimental.shard_map import shard_map
-                    wrapped = shard_map(fused, mesh=mesh, in_specs=(spec,),
-                                        out_specs=spec, check_rep=False)
+                from ..parallel.mesh import shard_map
+
+                wrapped = shard_map(fused, mesh=mesh, in_specs=(spec,),
+                                    out_specs=spec, check_vma=False)
                 return wrapped(batch)
             return fused(batch)
         x = batch.astype(jnp.float32)
@@ -231,12 +226,17 @@ class TPUModel(Transformer):
 
     # ---- async feed ---------------------------------------------------
     # CNTKModel overlaps host batching with native compute via the buffered
-    # batchers (Batchers.scala:12-65, CNTKModel.scala:88-140).  Here: host
-    # chunk assembly runs on a background thread (buffered_prefetch), each
-    # chunk is device_put + dispatched WITHOUT blocking (jax dispatch is
-    # async), and only a bounded in-flight window is awaited — transfer and
-    # device compute of consecutive chunks overlap.
-    _INFLIGHT = 3
+    # batchers (Batchers.scala:12-65, CNTKModel.scala:88-140).  Here the
+    # whole host->device movement is delegated to the DeviceFeed engine
+    # (io/feed.py): chunk assembly runs on its prefetch thread, ready
+    # chunks coalesce into packed single-`device_put` transfer groups (the
+    # fixed per-transfer cost dominates through a tunneled chip), and a
+    # bounded window of `feed_depth` groups stays in flight so decode,
+    # transfer, and compute overlap.
+    feed_depth = Param(
+        "host->device pipeline depth: packed transfer groups in flight "
+        "(2 suits most links; 4 helps very high-latency tunnels)",
+        default=2, converter=TypeConverters.to_int)
 
     def _stacking_builder(self, rows):
         """build_chunk callable for run_grouped that stacks row arrays and
@@ -295,32 +295,16 @@ class TPUModel(Transformer):
         return bs, (bs if n_rows > bs else dp)
 
     def run_chunk_iter(self, chunk_iter, jitted, dev_vars, mesh) -> List[np.ndarray]:
-        """Drive (padded_chunk, n_valid) pairs through the executor with the
-        async double-buffered feed; returns the per-row outputs in order.
-        `chunk_iter` runs on the prefetch thread, so host-side chunk
-        assembly (decode, buffer fill) overlaps device compute."""
-        outs: List[np.ndarray] = []
-        inflight: List[Any] = []
+        """Drive (padded_chunk, n_valid) pairs through the executor via the
+        DeviceFeed engine; returns the per-row outputs in order.
+        `chunk_iter` runs on the feed's prefetch thread (decode/assembly
+        overlap device compute), same-shape chunks coalesce into single
+        packed transfers, and `feed_depth` transfer groups stay in
+        flight."""
+        from ..io.feed import DeviceFeed
 
-        def drain_one():
-            y, n = inflight.pop(0)
-            outs.append(np.asarray(y)[:n])
-
-        for padded, n in buffered_prefetch(chunk_iter, self._INFLIGHT):
-            x = jax.device_put(padded, batch_sharding(mesh, padded.ndim))
-            y = jitted(dev_vars, x)
-            try:
-                # start device->host DMA as soon as the result is ready so
-                # the fetch overlaps later chunks' transfer/compute instead
-                # of serializing at drain time
-                y.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass
-            inflight.append((y, n))
-            if len(inflight) >= self._INFLIGHT:
-                drain_one()
-        while inflight:
-            drain_one()
+        feed = DeviceFeed(mesh=mesh, depth=int(self.feed_depth))
+        outs = feed.run(chunk_iter, lambda x: jitted(dev_vars, x))
         return [row for out in outs for row in out]
 
     def _transform(self, table: Table) -> Table:

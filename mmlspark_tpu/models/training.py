@@ -280,9 +280,15 @@ def fit_epochs(
         raise ValueError(
             f"dataset has {n} rows < batch_size {batch_size}; lower batch_size"
         )
+    from ..io.feed import DeviceFeed
+
     rng = np.random.default_rng(seed)
     metrics: Dict[str, float] = {}
     img_sh = NamedSharding(mesh, P(None, "data"))
+    # ONE feed engine for the whole fit: each slice/batch transfer is
+    # prefetched `depth` ahead (packed into a single device_put on one
+    # device) so the host never sits in device_put between dispatches
+    feed = DeviceFeed(mesh=mesh)
     for _epoch in range(epochs):
         order = rng.permutation(n)
         if epoch_fn is not None:
@@ -294,21 +300,21 @@ def fit_epochs(
             # datasets far larger than HBM; at most two compiled shapes
             # (the full slice and one remainder) across the whole fit
             k = scan_slice_steps(steps, bi[0].nbytes + bl[0].nbytes)
-            for s in range(0, steps, k):
-                state, ms = epoch_fn(
-                    state,
-                    jax.device_put(bi[s : s + k], img_sh),
-                    jax.device_put(bl[s : s + k], img_sh),
-                )
+            slices = ((bi[s : s + k], bl[s : s + k])
+                      for s in range(0, steps, k))
+            for dbi, dbl in feed.stream(slices, shardings=(img_sh, img_sh)):
+                state, ms = epoch_fn(state, dbi, dbl)
             metrics = {k2: float(np.asarray(v)[-1]) for k2, v in ms.items()}
             if log_fn:
                 log_fn(int(state.step), metrics)
             continue
-        for start in range(0, n - batch_size + 1, batch_size):
-            idx = order[start : start + batch_size]
-            bi = jax.device_put(images[idx], batch_sharding(mesh, 4))
-            bl = jax.device_put(labels[idx], batch_sharding(mesh, 1))
-            state, m = step_fn(state, bi, bl)
+        batches = ((images[order[start : start + batch_size]],
+                    labels[order[start : start + batch_size]])
+                   for start in range(0, n - batch_size + 1, batch_size))
+        for dbi, dbl in feed.stream(
+                batches, shardings=(batch_sharding(mesh, 4),
+                                    batch_sharding(mesh, 1))):
+            state, m = step_fn(state, dbi, dbl)
             metrics = {k: float(v) for k, v in m.items()}
             if log_fn:
                 log_fn(int(state.step), metrics)

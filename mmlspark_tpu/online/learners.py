@@ -87,7 +87,7 @@ def _allreduce_pass(mesh, loss: str):
     (VowpalWabbitBase.scala:434-462, endPass :363-368).
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..parallel.mesh import shard_map
 
     @partial(
         shard_map,

@@ -392,6 +392,8 @@ def kernel_ok(q) -> bool:
     # makes the kernel a net loss vs XLA dense — keep small heads on XLA
     if d < 64:
         return False
+    if _sig(s_p, d, q.dtype) in _REJECTED_FWD:
+        return False  # this signature's pallas lowering already failed
     return attention_fits_vmem(s_p, d, q.dtype.itemsize)
 
 
@@ -435,6 +437,17 @@ def _pad_seq(x, s_p):
 
 
 _NATIVE_D64_OK = None
+# Per-shape self-healing (the process-wide probe runs one tiny shape;
+# Mosaic's tiling rules depend on the FULL (S, D, dtype) signature, so a
+# passing probe does not clear every production shape).  A pallas_call
+# that raises for a signature lands here and never retries:
+_REJECTED_NATIVE_D: set = set()   # head dims whose 64-mod native run failed
+_REJECTED_FWD: set = set()        # (s_pad, d, dtype) -> XLA composition
+_REJECTED_BWD: set = set()        # (s_pad, d_pad, dtype) -> XLA recompute
+
+
+def _sig(s_p, d, dtype) -> tuple:
+    return (int(s_p), int(d), jnp.dtype(dtype).str)
 
 
 def _native_d64_ok() -> bool:
@@ -443,39 +456,58 @@ def _native_d64_ok() -> bool:
     zeros AND materializes 2x-size copies of q/k/v/o around every call —
     for d_head=64 models (the LM and ViT-B flagship shapes) that is pure
     waste when Mosaic takes the 64-minor tiles.  Probed ONCE per process
-    by compiling all three kernels on a tiny shape; a Mosaic rejection
-    self-heals to the padded path, so this can never cost a bench run."""
+    by compiling all three kernels on a tiny shape in the PRODUCTION
+    dtype (bf16 — Mosaic tiling is dtype-dependent: f32 (8, 128) tiles
+    passing says nothing about the (16, 128) bf16 tiles the real models
+    feed) and checking the forward numerically against the XLA
+    composition on RANDOM input (zeros compile-and-run can succeed while
+    the lowering is wrong: softmax over an all-zero score row hides any
+    normalization or masking bug).  A rejection self-heals to the padded
+    path, so this can never cost a bench run; shapes the probe wrongly
+    clears still self-heal per-signature via _REJECTED_NATIVE_D."""
     global _NATIVE_D64_OK
     if _NATIVE_D64_OK is None:
         if _interpret():
             _NATIVE_D64_OK = True  # interpret mode has no tiling rules
         else:
-            try:
-                import numpy as _np
-
-                z = jnp.asarray(_np.zeros((1, 128, 64), _np.float32))
-                st = jnp.zeros((1, 128, _LANE), jnp.float32)
-                o, lse = _attention_pallas(z, z, z, True, 0.125, None)
-                jax.block_until_ready(
-                    _attention_bwd_dkdv(z, z, z, z, st, st, True, 0.125,
-                                        None))
-                jax.block_until_ready(
-                    _attention_bwd_dq(z, z, z, z, st, st, True, 0.125,
-                                      None))
-                jax.block_until_ready(o)
-                _NATIVE_D64_OK = True
-            except Exception:  # noqa: BLE001 — any compile/run rejection
-                _NATIVE_D64_OK = False
+            _NATIVE_D64_OK = _probe_native_d64()
     return _NATIVE_D64_OK
+
+
+def _probe_native_d64() -> bool:
+    import numpy as _np
+
+    rng = _np.random.default_rng(0)
+    try:
+        q, k, v, do = (jnp.asarray(rng.standard_normal((1, 128, 64)),
+                                   jnp.bfloat16) for _ in range(4))
+        st = jnp.zeros((1, 128, _LANE), jnp.float32)
+        o, lse = _attention_pallas(q, k, v, True, 0.125, None)
+        jax.block_until_ready(
+            _attention_bwd_dkdv(q, k, v, do, st, st, True, 0.125, None))
+        jax.block_until_ready(
+            _attention_bwd_dq(q, k, v, do, st, st, True, 0.125, None))
+        o = _np.asarray(jax.block_until_ready(o))
+    except Exception:  # noqa: BLE001 — any compile/run rejection
+        return False
+    # numerical parity with the XLA composition, same bhsd inputs: the
+    # tolerance covers the kernel's one extra rounding (probabilities
+    # cast to bf16 at the PV matmul), two orders below a real mask/
+    # normalization bug (O(1) error)
+    ref = _np.asarray(
+        _xla_attention(q[:, :, None, :], k[:, :, None, :],
+                       v[:, :, None, :], True))[:, :, 0, :]
+    return bool(_np.max(_np.abs(o - ref)) <= 5e-2)
 
 
 def _kernel_d(d: int) -> int:
     """Head-dim the kernels run at: lane-multiple dims are native; the
-    64-mod-128 dims (64, 192, ...) stay native when the probe passes;
-    everything else pads up to the 128 lane."""
+    64-mod-128 dims (64, 192, ...) stay native when the probe passes and
+    no production shape at this head dim has been rejected; everything
+    else pads up to the 128 lane."""
     if d % _LANE == 0:
         return d
-    if d % 64 == 0 and _native_d64_ok():
+    if d % 64 == 0 and d not in _REJECTED_NATIVE_D and _native_d64_ok():
         return d
     return _pad_up(d, _LANE)
 
@@ -485,18 +517,34 @@ def _run_kernel(q, k, v, causal: bool):
     d_p = _kernel_d(d)
     s_p = _padded_len(s)
     kv_valid = s if s_p != s else None
-    o, lse = _attention_pallas(
-        _pad_seq(_to_bhsd(q, d_p), s_p), _pad_seq(_to_bhsd(k, d_p), s_p),
-        _pad_seq(_to_bhsd(v, d_p), s_p), causal,
-        1.0 / float(d) ** 0.5, kv_valid)
+    scale = 1.0 / float(d) ** 0.5
+    try:
+        o, lse = _attention_pallas(
+            _pad_seq(_to_bhsd(q, d_p), s_p), _pad_seq(_to_bhsd(k, d_p), s_p),
+            _pad_seq(_to_bhsd(v, d_p), s_p), causal, scale, kv_valid)
+    except Exception:  # noqa: BLE001 — per-shape Mosaic rejection
+        if d_p % _LANE == 0:
+            raise  # already lane-padded: nothing gentler to retry
+        # the probe cleared 64-mod head dims on a tiny shape alone; THIS
+        # signature's lowering was rejected — cache and retry padded (a
+        # padded failure escapes to the forward's XLA fallback)
+        _REJECTED_NATIVE_D.add(d)
+        d_p = _pad_up(d, _LANE)
+        o, lse = _attention_pallas(
+            _pad_seq(_to_bhsd(q, d_p), s_p), _pad_seq(_to_bhsd(k, d_p), s_p),
+            _pad_seq(_to_bhsd(v, d_p), s_p), causal, scale, kv_valid)
     # keep one lane of the broadcast lse as the backward residual
     return _from_bhsd(o[:, :s], b, s, h, d), lse[:, :s, 0]
 
 
 def _fused_attention_fwd(q, k, v, causal):
     if kernel_ok(q):
-        out, lse = _run_kernel(q, k, v, causal)
-        return out, (q, k, v, out, lse)
+        try:
+            out, lse = _run_kernel(q, k, v, causal)
+            return out, (q, k, v, out, lse)
+        except Exception:  # noqa: BLE001 — even padded pallas rejected
+            _REJECTED_FWD.add(_sig(_padded_len(q.shape[1]), q.shape[3],
+                                   q.dtype))
     # fallback backward recomputes from q/k/v alone — saving `out` here
     # would keep a dead [B, S, H, D] f32 alive until the backward
     return _xla_attention(q, k, v, causal), (q, k, v, None, None)
@@ -511,6 +559,20 @@ def _fused_attention_bwd(causal, res, g):
     b, s, h, d = q.shape
     d_p = _kernel_d(d)  # same decision as _run_kernel (cached probe)
     s_p = _padded_len(s)
+    if _sig(s_p, d_p, q.dtype) not in _REJECTED_BWD:
+        try:
+            return _flash_bwd(q, k, v, out, lse, g, causal, d_p, s_p)
+        except Exception:  # noqa: BLE001 — per-shape Mosaic rejection of
+            # a backward kernel: cache it and recompute the exact XLA
+            # gradients from q/k/v (forward output is discarded)
+            _REJECTED_BWD.add(_sig(s_p, d_p, q.dtype))
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, d_p, s_p):
+    b, s, h, d = q.shape
     kv_valid = s if s_p != s else None
     scale = 1.0 / float(d) ** 0.5
     # delta = rowsum(dO * O) on the TRUE head dim (pad columns are zero).

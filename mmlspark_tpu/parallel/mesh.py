@@ -14,10 +14,30 @@ annotations; they ride ICI within a slice and DCN across slices.
 from __future__ import annotations
 
 import contextlib
+import inspect
 import math
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
+
+try:  # jax >= 0.6 re-exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # the 0.4.x line keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SM_HAS_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, **kw):
+    """Version-compat `shard_map`: ONE import site for the whole package
+    (jax moved it out of experimental in 0.6 and renamed `check_rep` to
+    `check_vma` with the varying-manual-axes type system in 0.7 — every
+    caller goes through here so no module breaks on either line)."""
+    if "check_vma" in kw and not _SM_HAS_VMA:
+        kw["check_rep"] = kw.pop("check_vma")
+    elif "check_rep" in kw and _SM_HAS_VMA:
+        kw["check_vma"] = kw.pop("check_rep")
+    return _shard_map(f, **kw)
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

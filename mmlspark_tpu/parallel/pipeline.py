@@ -22,8 +22,10 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import shard_map
 
 __all__ = ["pipeline_apply", "stack_stage_params"]
 
@@ -58,8 +60,13 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jnp.ndarray,
         params_local = jax.tree.map(lambda a: a[0], params_local)
         rank = jax.lax.axis_index(axis)
         # the carry becomes device-varying after the first ppermute; the
-        # zero init must carry the same varying-axes type
-        buf = jax.lax.pcast(jnp.zeros_like(xs[0]), (axis,), to="varying")
+        # zero init must carry the same varying-axes type (jax >= 0.7
+        # tracks varying manual axes — 0.4.x shard_map has no such type,
+        # so there the plain zeros carry is already correct)
+        buf = jnp.zeros_like(xs[0])
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            buf = pcast(buf, (axis,), to="varying")
 
         def body(buf, t):
             # stage 0 ingests microbatch t (while any remain); downstream

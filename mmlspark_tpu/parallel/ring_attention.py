@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import shard_map
+
 __all__ = ["full_attention", "ring_attention", "ulysses_attention"]
 
 
@@ -102,7 +104,7 @@ def _ring_driver(q, k, v, mesh: Mesh, axis: str, accumulate):
     seq_spec = P(None, axis, None, None)
 
     @partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
         out_specs=seq_spec,
         check_vma=False,
@@ -261,7 +263,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: Optional[str] = None,
     seq_spec = P(None, axis, None, None)
 
     @partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
         out_specs=seq_spec,
         check_vma=False,

@@ -114,9 +114,17 @@ class ContinuousBatcher:
                     "speculative batching with MoE needs drop-free "
                     f"capacity: set moe_capacity >= moe_experts "
                     f"({model.moe_experts}), got {model.moe_capacity}")
+        from ..io.feed import DeviceFeed
+
         self.model = model
         self.variables = {c: v for c, v in variables.items()
                           if c != "kvcache"}
+        # every host->device upload (per-tick token/pos/page-table vectors,
+        # admission prefill batches) rides the shared feed engine: the
+        # tick's 2-3 small arrays byte-pack into ONE device_put — through a
+        # high-latency link each separate transfer is a full round trip on
+        # the decode tick's critical path
+        self._feed = DeviceFeed()
         self.max_slots = int(max_slots)
         self.idle_sleep_s = float(idle_sleep_s)
         self.kv_cache_dtype = kv_cache_dtype
@@ -675,9 +683,10 @@ class ContinuousBatcher:
                 toks[i, :len(req.prompt) - st] = req.prompt[st:]
                 pos[i] = st
                 tables[i] = self._table[slot]
+            d_toks, d_fpos, d_tbls = self._feed.put_group(
+                [toks, pos, tables])
             logits, self._cache = self._step(
-                self.variables, jnp.asarray(toks), self._cache,
-                jnp.asarray(pos), jnp.asarray(tables))
+                self.variables, d_toks, self._cache, d_fpos, d_tbls)
             firsts = np.asarray(jnp.argmax(logits[
                 jnp.arange(kp), jnp.asarray(
                     [len(r.prompt) - st - 1 for _s, r, st in fill]
@@ -814,11 +823,17 @@ class ContinuousBatcher:
                 continue
             # ONE batched step for every slot (free slots compute too —
             # their pos 0 writes are dead: dense mode overwrites the rows
-            # on admit, paged mode routes them to the trash page)
+            # on admit, paged mode routes them to the trash page), fed by
+            # ONE packed upload of this tick's tok/pos(/table) vectors
+            if self.paged:
+                d_tok, d_pos, d_tbl = self._feed.put_group(
+                    [self._tok[:, None], self._pos, self._table])
+            else:
+                d_tok, d_pos = self._feed.put_group(
+                    [self._tok[:, None], self._pos])
+                d_tbl = None
             lg, self._cache = self._step(
-                self.variables, jnp.asarray(self._tok)[:, None],
-                self._cache, jnp.asarray(self._pos),
-                jnp.asarray(self._table) if self.paged else None)
+                self.variables, d_tok, self._cache, d_pos, d_tbl)
             nxt = np.asarray(jnp.argmax(lg[:, 0], axis=-1), np.int32)
             for slot in active:
                 self._pos[slot] += 1
@@ -834,13 +849,16 @@ class ContinuousBatcher:
         step writes the would-be-next K/V row so a fully-accepted round
         leaves no hole in the draft cache."""
         g = self.gamma
-        d_tok = jnp.asarray(self._tok)[:, None]
         dpos = self._pos.copy()
+        # the round's first draft step is the only one that uploads host
+        # data (later steps chain device outputs): tok+pos ride one
+        # packed transfer; per-step position bumps re-upload through the
+        # feed so the telemetry sees every byte on the wire
+        d_tok, d_pos = self._feed.put_group([self._tok[:, None], dpos])
         prop_list = []
         for i in range(g + 1):
             lg, self._d_cache = self._d_step(
-                self.draft_variables, d_tok, self._d_cache,
-                jnp.asarray(dpos))
+                self.draft_variables, d_tok, self._d_cache, d_pos)
             nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
             if i < g:
                 # keep proposals ON DEVICE: a host sync here would block
@@ -848,15 +866,21 @@ class ContinuousBatcher:
                 prop_list.append(nxt)
             d_tok = nxt[:, None]
             dpos += 1
+            if i < g:
+                d_pos = self._feed.put(dpos)
         props = np.asarray(jnp.stack(prop_list, axis=1), np.int32)  # [S, g]
         # ONE target forward verifies every slot's pending token + its g
         # proposals at the slot's own position: logits[:, j] predicts
         # position pos+j+1
         block = np.concatenate([self._tok[:, None], props], axis=1)
+        if self.paged:
+            d_blk, d_vpos, d_tbl = self._feed.put_group(
+                [block, self._pos, self._table])
+        else:
+            d_blk, d_vpos = self._feed.put_group([block, self._pos])
+            d_tbl = None
         lg, self._cache = self._step(
-            self.variables, jnp.asarray(block), self._cache,
-            jnp.asarray(self._pos),
-            jnp.asarray(self._table) if self.paged else None)
+            self.variables, d_blk, self._cache, d_vpos, d_tbl)
         t_pred = np.asarray(jnp.argmax(lg, axis=-1), np.int32)  # [S, g+1]
         for slot in active:
             match = t_pred[slot, :g] == props[slot]

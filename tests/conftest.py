@@ -21,6 +21,10 @@ if not _ON_TPU:
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# NOTE: do NOT enable the persistent compilation cache
+# (JAX_COMPILATION_CACHE_DIR) here: this jaxlib segfaults executing
+# donated-argument pjit programs deserialized from the cache on the CPU
+# backend (reproducible via test_deep_vision with the cache on).
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -42,6 +46,13 @@ else:
 
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running / wall-clock-sensitive; excluded from the "
+        "tier-1 gate (-m 'not slow')")
 
 
 @pytest.fixture

@@ -199,3 +199,129 @@ def test_attention_kernel_compiles_on_tpu():
     ref = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=0.02, rtol=0.02)
+
+
+# ---- per-shape Mosaic-rejection self-healing -------------------------------
+
+@pytest.fixture
+def _clean_rejection_caches():
+    """The rejection caches are process-global by design (self-heal once,
+    never retry); tests that poison them must restore the pre-test state."""
+    from mmlspark_tpu.ops import attention_kernels as ak
+
+    saved = (set(ak._REJECTED_NATIVE_D), set(ak._REJECTED_FWD),
+             set(ak._REJECTED_BWD))
+    yield
+    for cache, prev in zip((ak._REJECTED_NATIVE_D, ak._REJECTED_FWD,
+                            ak._REJECTED_BWD), saved):
+        cache.clear()
+        cache.update(prev)
+
+
+def test_forward_pallas_rejection_heals_to_xla(monkeypatch,
+                                               _clean_rejection_caches):
+    """A pallas_call that raises for a production shape must fall back to
+    the XLA composition (numerically, not just route), cache the
+    rejection, and flip kernel_ok for that signature."""
+    from mmlspark_tpu.ops import attention_kernels as ak
+
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 128, 2, 128)), jnp.float32)
+               for _ in range(3))
+    assert ak.kernel_ok(q)
+
+    def boom(*a, **kw):
+        raise RuntimeError("Mosaic rejected this shape")
+
+    monkeypatch.setattr(ak, "_attention_pallas", boom)
+    got = fused_attention(q, k, v, True)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert not ak.kernel_ok(q)  # cached: never retried for this signature
+    # and with the kernel healthy again, OTHER signatures still take it
+    q2 = jnp.asarray(rng.normal(size=(1, 256, 2, 128)), jnp.float32)
+    assert ak.kernel_ok(q2)
+
+
+def test_native_d64_rejection_retries_padded(monkeypatch,
+                                             _clean_rejection_caches):
+    """A per-shape failure of the NATIVE 64-lane path must retry padded
+    to the 128 lane (not collapse straight to XLA) and remember the head
+    dim, exactly the ADVICE.md scenario: d=192/320 enabled off the tiny
+    f32 probe alone."""
+    from mmlspark_tpu.ops import attention_kernels as ak
+
+    rng = np.random.default_rng(6)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 128, 2, 64)), jnp.float32)
+               for _ in range(3))
+    monkeypatch.setattr(ak, "_native_d64_ok", lambda: True)
+    assert ak._kernel_d(64) == 64
+
+    real = ak._attention_pallas
+    seen_d = []
+
+    def native_fails(qp, kp, vp, *a, **kw):
+        seen_d.append(qp.shape[-1])
+        if qp.shape[-1] % 128:
+            raise RuntimeError("Mosaic rejected the 64-minor tile")
+        return real(qp, kp, vp, *a, **kw)
+
+    monkeypatch.setattr(ak, "_attention_pallas", native_fails)
+    got = fused_attention(q, k, v, True)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert seen_d == [64, 128]          # native try, then the padded retry
+    assert 64 in ak._REJECTED_NATIVE_D  # cached...
+    fused_attention(q, k, v, True)
+    assert seen_d == [64, 128, 128]     # ...so the retry never repeats
+
+
+def test_backward_pallas_rejection_heals_to_xla_grads(
+        monkeypatch, _clean_rejection_caches):
+    """A backward-kernel rejection must cache and recompute the exact XLA
+    gradients — training keeps running, with correct grads, on a shape
+    whose flash backward Mosaic refuses."""
+    from mmlspark_tpu.ops import attention_kernels as ak
+
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 128, 2, 128)), jnp.float32)
+               for _ in range(3))
+
+    def boom(*a, **kw):
+        raise RuntimeError("Mosaic rejected the dkdv kernel")
+
+    monkeypatch.setattr(ak, "_attention_bwd_dkdv", boom)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, True) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+    assert ak._REJECTED_BWD
+
+
+def test_probe_parity_check_catches_wrong_numerics(monkeypatch):
+    """The d64 probe must fail a kernel that compiles and runs but
+    returns wrong numbers (the compile-on-zeros blind spot): a lowering
+    that silently zeroes the output passes block_until_ready and would
+    have enabled the native path under the old probe."""
+    from mmlspark_tpu.ops import attention_kernels as ak
+
+    assert ak._probe_native_d64() is True  # interpret-mode kernel is exact
+
+    real = ak._attention_pallas
+
+    def wrong(qp, kp, vp, *a, **kw):
+        o, lse = real(qp, kp, vp, *a, **kw)
+        return o * 0.0, lse
+
+    monkeypatch.setattr(ak, "_attention_pallas", wrong)
+    assert ak._probe_native_d64() is False

@@ -29,16 +29,7 @@ BATCH = 32
 MIN_RATIO = 0.70
 
 
-@pytest.mark.skipif(not native.jpeg_available(),
-                    reason="needs the native JPEG decoder (streaming path)")
-def test_mixed_shape_groups_share_one_feed_window():
-    """Shape-grouped input must flow through ONE bounded in-flight window
-    (TPUModel.run_grouped): with 3 JPEG shape groups the e2e throughput
-    has to stay within 2x of the single-shape streaming path on the same
-    pixel count — a per-group pipeline drain (the pre-round-5 behavior)
-    shows up here as 3 serial pipelines plus per-group warm-up bubbles."""
-    import jax.numpy as jnp
-
+def _mixed_tables():
     rng = np.random.default_rng(1)
 
     def jpeg(h, w):
@@ -50,6 +41,61 @@ def test_mixed_shape_groups_share_one_feed_window():
     mixed = Table({"image": [jpeg(*[(128, 128), (144, 128), (128, 160)][i % 3])
                              for i in range(48)]})
     mono = Table({"image": [jpeg(128, 128) for _ in range(48)]})
+    return mixed, mono
+
+
+@pytest.mark.skipif(not native.jpeg_available(),
+                    reason="needs the native JPEG decoder (streaming path)")
+def test_mixed_shape_groups_share_one_feed_window(monkeypatch):
+    """Shape-grouped input must flow through ONE bounded in-flight window
+    (TPUModel.run_grouped): a per-group pipeline drain (the pre-round-5
+    behavior) opened one window per shape group, paying a warm-up bubble
+    and a full drain at every group boundary.  Structural proof, immune
+    to 1-core CI timing noise: count feed-window invocations while the
+    three shape groups' chunks all flow through it."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.tpu_model import TPUModel
+
+    mixed, _ = _mixed_tables()
+    bundle = FlaxBundle("resnet18", {"num_classes": 10, "dtype": jnp.float32},
+                        input_shape=(112, 112, 3), seed=0)
+    feat = ImageFeaturizer(bundle=bundle, input_col="image",
+                           output_col="features", batch_size=16)
+
+    windows = []          # one entry per feed-window (run_chunk_iter) call
+    chunk_shapes = set()  # source shapes of the chunks that flowed through
+    orig = TPUModel.run_chunk_iter
+
+    def counted(self, chunk_iter, jitted, dev_vars, mesh):
+        def spy():
+            for padded, n in chunk_iter:
+                chunk_shapes.add(tuple(padded.shape[1:]))
+                yield padded, n
+
+        windows.append(1)
+        return orig(self, spy(), jitted, dev_vars, mesh)
+
+    monkeypatch.setattr(TPUModel, "run_chunk_iter", counted)
+    out = feat.transform(mixed)
+    assert out["features"].shape[0] == 48
+    assert len(chunk_shapes) == 3, (
+        f"expected 3 decode shape groups, saw {sorted(chunk_shapes)}")
+    assert len(windows) == 1, (
+        f"{len(windows)} feed windows opened for 3 shape groups — the "
+        "groups are not sharing one bounded in-flight window")
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not native.jpeg_available(),
+                    reason="needs the native JPEG decoder (streaming path)")
+def test_mixed_shape_groups_timing_stays_bounded():
+    """Timing companion to the structural window check (slow: wall-clock
+    ratios flake on the 1-core CI host, so the margin is wide — 3 serial
+    per-group pipelines with drain bubbles measured well above 3x)."""
+    import jax.numpy as jnp
+
+    mixed, mono = _mixed_tables()
     bundle = FlaxBundle("resnet18", {"num_classes": 10, "dtype": jnp.float32},
                         input_shape=(112, 112, 3), seed=0)
     feat = ImageFeaturizer(bundle=bundle, input_col="image",
@@ -66,7 +112,7 @@ def test_mixed_shape_groups_share_one_feed_window():
             best = dt if best is None else min(best, dt)
         times[name] = best
     ratio = times["mixed"] / times["mono"]
-    assert ratio < 2.0, (
+    assert ratio < 3.0, (
         f"mixed-shape e2e is {ratio:.2f}x the single-shape time — "
         "the shape groups are not sharing one feed window")
 

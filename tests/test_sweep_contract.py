@@ -185,9 +185,10 @@ def test_lm_ablate_smoke_emits_json():
     assert proc.returncode == 0, proc.stderr[-800:]
     recs = [json.loads(l) for l in proc.stdout.strip().splitlines()
             if l.startswith("{")]
-    assert len(recs) == 4, recs
+    assert len(recs) == 6, recs
     tags = {r["tag"] for r in recs}
-    assert {"baseline_b16", "fwd_only_b16", "xla_attn_b16", "b32"} == tags
+    assert {"baseline_b16", "fwd_only_b16", "xla_attn_b16", "b32",
+            "no_attn_b16", "h6_d128_b16"} == tags
     for rec in recs:
         assert rec["smoke"] is True
         assert rec["ms_per_step"] > 0

@@ -1,0 +1,111 @@
+"""Feed microbench: naive per-chunk device_put vs the DeviceFeed engine.
+
+Measures the two quantities the engine exists to improve, on whatever
+backend is attached (the tunneled chip for real numbers; CPU for the
+structural check tests/test_device_feed.py asserts):
+
+  transfer_calls : fixed per-transfer round trips paid — the cost that
+                   dominates h2d through a high-latency tunnel
+  wall_s / ips   : end wall time for transfer+compute of every chunk
+
+    python tools/feed_bench.py [--images 256] [--chunks 16] [--side 224]
+                               [--depth 2] [--coalesce 8]
+
+Prints one JSON object: {"naive": {...}, "coalesced": {...}, "speedup",
+"transfer_call_ratio"}.  The acceptance bar from ISSUE 2 is
+transfer_call_ratio >= 4 for 256 images in 16 chunks.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run_naive(chunks, compute):
+    import jax
+
+    outs = []
+    t0 = time.perf_counter()
+    for c, n in chunks:
+        x = jax.device_put(c)
+        outs.append((compute(x), n))
+    res = [np.asarray(y)[:n] for y, n in outs]
+    return res, time.perf_counter() - t0, len(chunks)
+
+
+def _run_feed(chunks, compute, depth, coalesce, tel):
+    from mmlspark_tpu.io.feed import DeviceFeed
+
+    feed = DeviceFeed(depth=depth, coalesce=coalesce, telemetry=tel)
+    t0 = time.perf_counter()
+    res = feed.run(iter(chunks), compute, greedy=False)
+    return res, time.perf_counter() - t0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--images", type=int, default=256)
+    ap.add_argument("--chunks", type=int, default=16)
+    ap.add_argument("--side", type=int, default=224)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--coalesce", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.io.feed import FeedTelemetry
+
+    bs = args.images // args.chunks
+    rng = np.random.default_rng(0)
+    chunks = [(rng.integers(0, 255, (bs, args.side, args.side, 3),
+                            dtype=np.int64).astype(np.uint8), bs)
+              for _ in range(args.chunks)]
+
+    # cheap on-device reduction: enough compute to overlap against, not
+    # enough to hide a slow feed entirely
+    @jax.jit
+    def compute(x):
+        return jnp.asarray(x, jnp.float32).mean(axis=(1, 2, 3))
+
+    # warm both paths (compile outside the timed region)
+    _run_naive(chunks[:1], compute)
+    tel_warm = FeedTelemetry()
+    _run_feed(chunks[: min(2, len(chunks))], compute, args.depth,
+              args.coalesce, tel_warm)
+
+    naive_res, naive_s, naive_calls = _run_naive(chunks, compute)
+    tel = FeedTelemetry()
+    feed_res, feed_s = _run_feed(chunks, compute, args.depth,
+                                 args.coalesce, tel)
+    for a, b in zip(naive_res, feed_res):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    calls = int(tel.snapshot()["transfer_calls"])
+
+    out = {
+        "platform": jax.devices()[0].platform,
+        "images": args.images, "chunks": args.chunks,
+        "depth": args.depth, "coalesce": args.coalesce,
+        "naive": {"wall_s": round(naive_s, 4),
+                  "ips": round(args.images / naive_s, 1),
+                  "transfer_calls": naive_calls},
+        "coalesced": {"wall_s": round(feed_s, 4),
+                      "ips": round(args.images / feed_s, 1),
+                      "transfer_calls": calls,
+                      **FeedTelemetry.summarize(tel.snapshot())},
+        "speedup": round(naive_s / feed_s, 3),
+        "transfer_call_ratio": round(naive_calls / max(calls, 1), 2),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,8 @@ def measure(tag, batch=16, seq=1024, steps=8, attn_fn=None, fwd_only=False,
         epoch = make_lm_train_epoch(model, opt, donate=False)
         try:
             cost = epoch.lower(params, opt_state, tokens[:1]).cost_analysis()
+            if isinstance(cost, (list, tuple)):  # jax 0.4.x list-of-dicts
+                cost = cost[0] if cost else {}
             flops_step = float(cost["flops"])
         except Exception:  # noqa: BLE001
             flops_step = 0.0

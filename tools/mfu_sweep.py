@@ -101,6 +101,8 @@ def child(batch: int, builder: str = "resnet50") -> int:
     x = jnp.asarray(rng.normal(size=(batch, side, side, 3)), jnp.bfloat16)
     compiled = jax.jit(forward).lower(dev_vars, x).compile()
     cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):  # jax 0.4.x: one dict per computation
+        cost = cost[0] if cost else {}
     flops = float(cost.get("flops", 0.0))
     bytes_acc = float(cost.get("bytes accessed", 0.0))
     ms = _bench_ms(compiled, dev_vars, x, iters=iters)
