@@ -17,7 +17,8 @@ import numpy as np
 from ..core.params import Param, ServiceParam, TypeConverters
 from ..core.pipeline import Transformer
 from ..core.schema import Table
-from ..io.http.clients import AsyncHTTPClient, HandlingUtils, get_shared_client
+from ..io.http.clients import (AsyncHTTPClient, CircuitBreaker,
+                               HandlingUtils, get_breaker, get_shared_client)
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 
 __all__ = ["CognitiveServicesBase", "BasicAsyncReply"]
@@ -40,6 +41,14 @@ class CognitiveServicesBase(Transformer):
                         converter=TypeConverters.to_int)
     timeout = Param("per-request timeout (s)", default=60.0,
                     converter=TypeConverters.to_float)
+    breaker_threshold = Param(
+        "circuit breaker: consecutive retryable failures before the "
+        "endpoint's shared circuit opens (0 disables — the default)",
+        default=0, converter=TypeConverters.to_int)
+    breaker_reset_s = Param(
+        "circuit breaker: seconds an open circuit waits before admitting "
+        "a half-open probe", default=30.0,
+        converter=TypeConverters.to_float)
 
     _path = ""  # subclass: service URL path
     _domain = "api.cognitive.microsoft.com"
@@ -77,6 +86,17 @@ class CognitiveServicesBase(Transformer):
     def _client(self) -> AsyncHTTPClient:
         return get_shared_client(int(self.concurrency), float(self.timeout))
 
+    def _breaker(self) -> Optional[CircuitBreaker]:
+        """Per-HOST shared breaker (all stages hitting the same endpoint
+        pool their failure budget), or None when disabled."""
+        if int(self.breaker_threshold) <= 0:
+            return None
+        from urllib.parse import urlsplit
+
+        host = urlsplit(self._base_url()).netloc or self._base_url()
+        return get_breaker(host, int(self.breaker_threshold),
+                           float(self.breaker_reset_s))
+
     def _transform(self, table: Table) -> Table:
         n = len(table)
         reqs: List[Optional[HTTPRequestData]] = []
@@ -92,7 +112,7 @@ class CognitiveServicesBase(Transformer):
                 entity=entity,
             ))
         client = self._client()
-        resps = client.send_all(reqs)
+        resps = client.send_all(reqs, breaker=self._breaker())
         # post-handling (e.g. async-operation polling) runs through the same
         # bounded pool: rows poll concurrently, not one-after-another
         resps = list(client._pool.map(
@@ -140,10 +160,12 @@ class BasicAsyncReply(CognitiveServicesBase):
             return resp
         poll_req = HTTPRequestData(url=loc, method="GET",
                                    headers=self._headers(table, i))
+        breaker = self._breaker()
         for attempt in range(int(self.max_polls)):
             if attempt:  # first status check is immediate
                 time.sleep(float(self.polling_interval_ms) / 1000.0)
-            poll = HandlingUtils.advanced(poll_req, timeout=float(self.timeout))
+            poll = HandlingUtils.advanced(poll_req, timeout=float(self.timeout),
+                                          breaker=breaker)
             if not poll.ok:
                 return poll
             try:

@@ -4,6 +4,13 @@ Reference: core logging/BasicLogging.scala:25-71 — logClass/logFit/logTransfor
 emit `{uid, className, method, buildVersion}`.  Here: a process-local ring
 buffer + stdlib logging, cheap enough to stay always-on, with wall-time capture
 (also covering stages/Timer.scala:55 TimerModel semantics).
+
+Also the process-wide **event counter** sink: every fault/retry/shed/
+degrade event in the resilience layer (io/feed retries and degradations,
+serving load shedding and deadline expiries, circuit-breaker transitions,
+training auto-checkpoint/resume, injected faults) increments a named
+counter here, so chaos runs and production incidents read off one ledger
+(`counters()` / `reset_counters()`); see docs/robustness.md.
 """
 from __future__ import annotations
 
@@ -11,14 +18,42 @@ import collections
 import contextlib
 import json
 import logging
+import threading
 import time
-from typing import Any, Deque, Dict
+from typing import Any, Deque, Dict, Optional
 
 from .. import version
 
 logger = logging.getLogger("mmlspark_tpu.telemetry")
 
 _RECORDS: Deque[Dict[str, Any]] = collections.deque(maxlen=4096)
+
+_COUNTERS: Dict[str, int] = {}
+_COUNTERS_LOCK = threading.Lock()
+
+
+def incr(name: str, n: int = 1) -> None:
+    """Bump a named event counter (dotted names: 'serving.shed')."""
+    with _COUNTERS_LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def counters(prefix: Optional[str] = None) -> Dict[str, int]:
+    """Snapshot the event counters, optionally filtered by name prefix."""
+    with _COUNTERS_LOCK:
+        if prefix is None:
+            return dict(_COUNTERS)
+        return {k: v for k, v in _COUNTERS.items() if k.startswith(prefix)}
+
+
+def reset_counters(prefix: Optional[str] = None) -> None:
+    """Zero the counters (tests); with `prefix`, only matching names."""
+    with _COUNTERS_LOCK:
+        if prefix is None:
+            _COUNTERS.clear()
+        else:
+            for k in [k for k in _COUNTERS if k.startswith(prefix)]:
+                del _COUNTERS[k]
 
 
 def recent_records():

@@ -35,6 +35,16 @@ is amortized the same way, JAX-first:
     stall seconds, and wall time accumulate in `FEED_TELEMETRY`;
     `bench.py` folds the derived `overlap_frac`/`stall_s`/`h2d_gbps`
     into its JSON line.  See docs/performance.md ("The h2d feed").
+  * **Fault tolerance.**  Every `device_put` sits behind the
+    `feed.device_put` fault point with a bounded retry
+    (`transfer_retries`, tiny backoff — a transient link hiccup costs
+    microseconds, not a failed batch).  A PACKED transfer that fails all
+    its retries **degrades the engine**: the group falls back to plain
+    per-chunk puts and the instance stays on the safe unpipelined path
+    (no coalescing, no in-flight window) for the rest of its life —
+    correctness first, the packed fast path is an optimization.  Retries
+    and degradations count into `core.telemetry` ("feed.transfer_retry",
+    "feed.degraded"); see docs/robustness.md (degradation ladder).
 """
 from __future__ import annotations
 
@@ -47,6 +57,9 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..core import telemetry as core_telemetry
+from ..utils.faults import fault_point
 
 __all__ = ["DeviceFeed", "FeedTelemetry", "FEED_TELEMETRY", "default_depth"]
 
@@ -155,15 +168,50 @@ class DeviceFeed:
 
     def __init__(self, mesh=None, depth: Optional[int] = None,
                  coalesce: int = 4, coalesce_bytes: int = 64 << 20,
-                 telemetry: Optional[FeedTelemetry] = None):
+                 telemetry: Optional[FeedTelemetry] = None,
+                 transfer_retries: int = 3):
         self.mesh = mesh
         self.depth = max(1, int(depth if depth is not None else default_depth()))
         self.coalesce = max(1, int(coalesce))
         self.coalesce_bytes = int(coalesce_bytes)
         self.telemetry = telemetry if telemetry is not None else FEED_TELEMETRY
+        self.transfer_retries = max(1, int(transfer_retries))
+        # a packed transfer that failed all its retries flips this: the
+        # instance stays on the safe per-chunk unpipelined path for the
+        # rest of its life (instances are per-transform/fit, so the blast
+        # radius of a flaky link is one consumer, not the process)
+        self.degraded = False
         self._rings: Dict[Any, List[_RingSlot]] = {}
         self._ring_pos: Dict[Any, int] = {}
         self._unpackers: Dict[Any, Callable] = {}
+
+    # ---- guarded transfer ----------------------------------------------
+    def _device_put(self, arr, sharding=None):
+        """The one raw `jax.device_put` in the engine: named fault point +
+        bounded retry with a tiny backoff (a transient link error costs
+        microseconds, not the batch)."""
+        import jax
+
+        last: Optional[BaseException] = None
+        for attempt in range(self.transfer_retries):
+            try:
+                fault_point("feed.device_put")
+                return (jax.device_put(arr, sharding) if sharding is not None
+                        else jax.device_put(arr))
+            except Exception as e:  # noqa: BLE001 — retried, then raised
+                last = e
+                if attempt == self.transfer_retries - 1:
+                    break
+                core_telemetry.incr("feed.transfer_retry")
+                time.sleep(min(0.001 * (2 ** attempt), 0.05))
+        raise last  # type: ignore[misc]
+
+    def _degrade(self, why: str):
+        if not self.degraded:
+            self.degraded = True
+            core_telemetry.incr("feed.degraded")
+            warnings.warn(f"DeviceFeed degraded to unpipelined transfers: {why}",
+                          RuntimeWarning, stacklevel=3)
 
     # ---- sharding helpers ----------------------------------------------
     def _dp(self) -> int:
@@ -192,8 +240,7 @@ class DeviceFeed:
 
         arr = np.asarray(arr)
         t0 = time.perf_counter()
-        out = (jax.device_put(arr, sharding) if sharding is not None
-               else jax.device_put(arr))
+        out = self._device_put(arr, sharding)
         if block:
             jax.block_until_ready(out)
         self.telemetry.add(bytes_moved=arr.nbytes, transfer_calls=1,
@@ -222,6 +269,8 @@ class DeviceFeed:
         multi = jax.device_count() > 1
         if multi and not sharded_multi and any(s is not None for s in shardings):
             return tuple(self.put(a, s) for a, s in zip(arrays, shardings))
+        if self.degraded:
+            return tuple(self.put(a, s) for a, s in zip(arrays, shardings))
 
         layout = []
         off = 0
@@ -233,7 +282,11 @@ class DeviceFeed:
         for a, (o, _s, _d) in zip(arrays, layout):
             slot.buf[o:o + a.nbytes] = a.reshape(-1).view(np.uint8)
         t0 = time.perf_counter()
-        packed = jax.device_put(slot.buf)
+        try:
+            packed = self._device_put(slot.buf)
+        except Exception as e:  # noqa: BLE001 — degrade, then the safe path
+            self._degrade(f"packed put_group failed after retries: {e}")
+            return tuple(self.put(a, s) for a, s in zip(arrays, shardings))
         self.telemetry.add(bytes_moved=total, transfer_calls=1,
                            transfer_s=time.perf_counter() - t0,
                            chunks_fed=len(arrays), groups=1,
@@ -319,13 +372,17 @@ class DeviceFeed:
 
         while not done or leftover is not None:
             # ---- collect the next group of ready chunks ----
+            # a degraded engine forms singleton groups and keeps nothing
+            # in flight (the safe unpipelined ladder rung; may flip
+            # mid-run when a packed transfer exhausts its retries)
+            coalesce_now = 1 if self.degraded else self.coalesce
             group: List[Tuple[np.ndarray, int]] = []
             gbytes = 0
             if leftover is not None:
                 group.append(leftover)
                 gbytes = leftover[0].nbytes
                 leftover = None
-            while len(group) < self.coalesce and gbytes < self.coalesce_bytes:
+            while len(group) < coalesce_now and gbytes < self.coalesce_bytes:
                 if not group or (not greedy and not done):
                     t0 = time.perf_counter()
                     item = q.get()
@@ -360,7 +417,7 @@ class DeviceFeed:
                 except (AttributeError, NotImplementedError):
                     pass
             inflight.append((ys, [n for _c, n in group], slot))
-            while len(inflight) > self.depth:
+            while len(inflight) > (0 if self.degraded else self.depth):
                 drain_group()
         while inflight:
             drain_group()
@@ -404,21 +461,24 @@ class DeviceFeed:
 
     def _transfer_group(self, group):
         """ONE device_put for the group; returns (device chunks, ring slot
-        or None).  Singletons skip packing entirely (no host copy)."""
-        import jax
-
+        or None).  Singletons skip packing entirely (no host copy).  A
+        packed transfer that fails all its retries degrades the engine and
+        the group falls back to per-chunk singleton transfers."""
         tel = self.telemetry
-        chunks = [c for c, _n in group]
-        k = len(chunks)
-        if k == 1:
-            c = chunks[0]
+
+        def put_one(c):
             sh = self._chunk_sharding(c.ndim)
             t0 = time.perf_counter()
-            x = jax.device_put(c, sh) if sh is not None else jax.device_put(c)
+            x = self._device_put(c, sh)
             tel.add(bytes_moved=c.nbytes, transfer_calls=1,
                     transfer_s=time.perf_counter() - t0,
                     chunks_fed=1, groups=1)
-            return [x], None
+            return x
+
+        chunks = [c for c, _n in group]
+        k = len(chunks)
+        if k == 1 or self.degraded:
+            return [put_one(c) for c in chunks], None
 
         first = chunks[0]
         homogeneous = all(c.shape == first.shape and c.dtype == first.dtype
@@ -434,8 +494,12 @@ class DeviceFeed:
                 slot.buf[i] = c
             t0 = time.perf_counter()
             sh = self._packed_sharding(slot.buf.ndim)
-            packed = (jax.device_put(slot.buf, sh) if sh is not None
-                      else jax.device_put(slot.buf))
+            try:
+                packed = self._device_put(slot.buf, sh)
+            except Exception as e:  # noqa: BLE001 — degrade, then safe path
+                slot.busy = False
+                self._degrade(f"packed stack transfer failed after retries: {e}")
+                return [put_one(c) for c in chunks], None
             tel.add(bytes_moved=slot.buf.nbytes, transfer_calls=1,
                     transfer_s=time.perf_counter() - t0,
                     chunks_fed=k, groups=1, coalesced_chunks=k)
@@ -456,7 +520,12 @@ class DeviceFeed:
         for c, (o, _s, _d) in zip(chunks, layout):
             slot.buf[o:o + c.nbytes] = c.reshape(-1).view(np.uint8)
         t0 = time.perf_counter()
-        packed = jax.device_put(slot.buf)
+        try:
+            packed = self._device_put(slot.buf)
+        except Exception as e:  # noqa: BLE001 — degrade, then safe path
+            slot.busy = False
+            self._degrade(f"packed byte transfer failed after retries: {e}")
+            return [put_one(c) for c in chunks], None
         tel.add(bytes_moved=total, transfer_calls=1,
                 transfer_s=time.perf_counter() - t0,
                 chunks_fed=k, groups=1, coalesced_chunks=k)

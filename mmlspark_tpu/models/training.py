@@ -19,7 +19,8 @@ from ..parallel.mesh import batch_sharding, default_mesh, replicated_sharding
 
 __all__ = ["TrainState", "make_train_step", "make_train_epoch",
            "make_lm_train_epoch", "make_distill_epoch", "make_eval_step",
-           "fit_epochs", "shard_params", "scan_slice_steps"]
+           "fit_epochs", "fit_epochs_resumable", "shard_params",
+           "scan_slice_steps"]
 
 # device-memory budget for one scanned slice of training data; a full
 # epoch is scanned in slices of at most this many bytes so device memory
@@ -318,6 +319,100 @@ def fit_epochs(
             metrics = {k: float(v) for k, v in m.items()}
             if log_fn:
                 log_fn(int(state.step), metrics)
+    return state, metrics
+
+
+def fit_epochs_resumable(
+    step_fn,
+    state: TrainState,
+    images: np.ndarray,
+    labels: np.ndarray,
+    batch_size: int,
+    checkpoint_dir,
+    epochs: int = 1,
+    checkpoint_every: int = 50,
+    max_to_keep: int = 3,
+    mesh: Optional[Mesh] = None,
+    seed: int = 0,
+    log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
+) -> Tuple[TrainState, Dict[str, float]]:
+    """fit_epochs that survives being killed: auto-checkpoints every
+    `checkpoint_every` steps through CheckpointManager and, on the next
+    call with the same `checkpoint_dir`, resumes from the latest
+    checkpoint — reproducing the uninterrupted run EXACTLY.
+
+    Exactness rests on two invariants:
+
+    * the batch schedule is a pure function of (seed, epoch): each
+      epoch's shuffle uses its OWN ``np.random.default_rng([seed,
+      epoch])``, so a resume at any global step regenerates the same
+      order without replaying earlier epochs' draws (fit_epochs threads
+      one RNG through all epochs — resumable cannot);
+    * orbax restore is bit-exact, so the restored TrainState continues
+      the identical float trajectory (asserted on CPU in tests; see
+      docs/robustness.md "kill-and-resume").
+
+    The loop runs per-step (the scanned epoch_fn path would quantize
+    checkpoints to epoch boundaries) and crosses `fault_point
+    ("training.step")` each step so chaos tests can kill it mid-epoch.
+    Telemetry: ``training.autosave`` per checkpoint written,
+    ``training.resume`` when a run starts from a restored step."""
+    from ..core import telemetry as core_telemetry
+    from ..io.feed import DeviceFeed
+    from ..utils.faults import fault_point
+    # lazy: checkpoint.py imports TrainState from this module
+    from .checkpoint import CheckpointManager
+
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    mesh = mesh or default_mesh()
+    dp = mesh.shape["data"]
+    if batch_size % dp != 0:
+        raise ValueError(f"batch_size {batch_size} not divisible by "
+                         f"data-parallel degree {dp}")
+    n = len(images)
+    steps_per_epoch = n // batch_size
+    if steps_per_epoch < 1:
+        raise ValueError(
+            f"dataset has {n} rows < batch_size {batch_size}; lower batch_size")
+
+    mgr = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+    try:
+        latest = mgr.latest_step()
+        if latest is not None and latest > int(state.step):
+            state = mgr.restore(step=latest, template=state)
+            core_telemetry.incr("training.resume")
+        start = int(state.step)
+        total = epochs * steps_per_epoch
+        feed = DeviceFeed(mesh=mesh)
+        img_sh = batch_sharding(mesh, np.ndim(images))
+        lbl_sh = batch_sharding(mesh, np.ndim(labels))
+        metrics: Dict[str, float] = {}
+        order = None
+        order_epoch = -1
+        for g in range(start, total):
+            epoch, b = divmod(g, steps_per_epoch)
+            if epoch != order_epoch:
+                # schedule is (seed, epoch)-pure: resume regenerates it
+                order = np.random.default_rng([seed, epoch]).permutation(n)
+                order_epoch = epoch
+            fault_point("training.step")
+            idx = order[b * batch_size:(b + 1) * batch_size]
+            dbi, dbl = feed.put_group([images[idx], labels[idx]],
+                                      shardings=(img_sh, lbl_sh))
+            state, m = step_fn(state, dbi, dbl)
+            metrics = {k: float(v) for k, v in m.items()}
+            if log_fn:
+                log_fn(int(state.step), metrics)
+            if int(state.step) % checkpoint_every == 0:
+                mgr.save(state, wait=True)
+                core_telemetry.incr("training.autosave")
+        if total > start and int(state.step) % checkpoint_every != 0:
+            mgr.save(state, wait=True)  # final state always resumable
+            core_telemetry.incr("training.autosave")
+    finally:
+        mgr.close()
     return state, metrics
 
 

@@ -23,6 +23,7 @@ gives token-by-token HTTP with cross-request batching on the device.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from queue import Empty, Queue
 from typing import Iterator, List, Optional
@@ -30,6 +31,9 @@ from typing import Iterator, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..core import telemetry
+from ..utils.fault_tolerance import Overloaded
 
 __all__ = ["ContinuousBatcher", "TokenStream"]
 
@@ -62,11 +66,13 @@ class TokenStream:
 
 class _Request:
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
-                 eos_id: Optional[int], prefix: Optional[int] = None):
+                 eos_id: Optional[int], prefix: Optional[int] = None,
+                 deadline: Optional[float] = None):
         self.prompt = prompt          # FULL ids (shared prefix + suffix)
         self.max_new = int(max_new_tokens)
         self.eos_id = eos_id
         self.prefix = prefix          # register_prefix handle, or None
+        self.deadline = deadline      # absolute monotonic admission budget
         self.stream = TokenStream()
         self.emitted = 0
 
@@ -90,6 +96,7 @@ class ContinuousBatcher:
 
     def __init__(self, model, variables, max_slots: int = 8,
                  idle_sleep_s: float = 0.001,
+                 max_pending: Optional[int] = None,
                  kv_cache_dtype: str = None,
                  paged: bool = False, page_size: int = 64,
                  num_pages: Optional[int] = None,
@@ -127,6 +134,9 @@ class ContinuousBatcher:
         self._feed = DeviceFeed()
         self.max_slots = int(max_slots)
         self.idle_sleep_s = float(idle_sleep_s)
+        # bounded intake: submit() sheds (raises Overloaded) once this many
+        # requests wait for a slot; None = unbounded (the seed behavior)
+        self.max_pending = None if max_pending is None else int(max_pending)
         self.kv_cache_dtype = kv_cache_dtype
         self.paged = bool(paged)
         self.draft_model = draft_model
@@ -390,10 +400,24 @@ class ContinuousBatcher:
     # ---- client side ---------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                eos_id: Optional[int] = None,
-               prefix: Optional[int] = None) -> TokenStream:
+               prefix: Optional[int] = None,
+               deadline: Optional[float] = None) -> TokenStream:
         """`prefix`: a register_prefix handle — `prompt_ids` is then the
         SUFFIX appended to the shared prefix (may be empty), and
-        admission prefills only the suffix."""
+        admission prefills only the suffix.
+
+        `deadline`: absolute `time.monotonic()` budget for ADMISSION — a
+        request still waiting for a slot past it is failed fast with a
+        TimeoutError on its stream instead of being computed (already
+        admitted streams run to completion).  Load shedding: when
+        `max_pending` is set and that many requests already wait,
+        submit raises Overloaded (serving maps it to 503 + Retry-After)."""
+        if self.max_pending is not None and (
+                self._pending.qsize() + len(self._buffer)
+                >= self.max_pending):
+            telemetry.incr("batcher.shed")
+            raise Overloaded(
+                f"batcher intake full ({self.max_pending} pending)")
         shared_pages = 0
         if prefix is not None:
             if not self.paged:
@@ -419,7 +443,8 @@ class ContinuousBatcher:
                 f"max_len {self.model.max_len}"
                 + (f" - gamma {self.gamma} (speculative lookahead)"
                    if self.gamma else ""))
-        req = _Request(prompt, max_new_tokens, eos_id, prefix=prefix)
+        req = _Request(prompt, max_new_tokens, eos_id, prefix=prefix,
+                       deadline=deadline)
         with self._submit_lock:
             if self.paged:
                 worst = self._worst_pages(len(prompt), int(max_new_tokens),
@@ -768,6 +793,24 @@ class ContinuousBatcher:
                     f"exceeds the {ceiling} pages that can ever free up "
                     "(prefixes registered after submit hold the rest)")
                 head.stream._q.put(None)
+        if any(r.deadline is not None for r in self._buffer):
+            # fail-fast: an expired request must not consume a prefill —
+            # its client has already given up (deadline semantics match
+            # WorkerServer._admit; docs/robustness.md)
+            now = time.monotonic()
+            kept: "deque[_Request]" = deque()
+            for req in self._buffer:
+                if req.deadline is not None and req.deadline <= now:
+                    if req.prefix is not None:
+                        with self._submit_lock:
+                            self._prefixes[req.prefix]["refs"] -= 1
+                    telemetry.incr("batcher.deadline_expired")
+                    req.stream.error = TimeoutError(
+                        "request deadline expired before batch admission")
+                    req.stream._q.put(None)
+                else:
+                    kept.append(req)
+            self._buffer = kept
         batch = []
         for slot in range(self.max_slots):
             if not self._buffer:

@@ -111,9 +111,17 @@ class EpochJournal:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # torn tail write from the crash: ignore
+                if not isinstance(rec, dict) or "id" not in rec:
+                    # valid JSON but not a journal record (torn write that
+                    # happens to parse, or foreign junk): recovery must
+                    # salvage the rest of the file, not die on one line
+                    continue
                 if rec.get("t") == "req":
-                    reqs[rec["id"]] = (base64.b64decode(rec.get("e", "")),
-                                       rec.get("h", {}))
+                    try:
+                        entity = base64.b64decode(rec.get("e", ""))
+                    except (ValueError, TypeError):
+                        continue  # corrupt payload: unrecoverable record
+                    reqs[rec["id"]] = (entity, rec.get("h", {}))
                 elif rec.get("t") == "rep":
                     reqs.pop(rec["id"], None)
         self._outstanding = dict(reqs)
@@ -129,6 +137,10 @@ class EpochJournal:
                     rec["h"] = headers
                 f.write(json.dumps(rec) + "\n")
             f.flush()
+            # fsync BEFORE the rename: os.replace is atomic for the name,
+            # but without it a power loss can leave the new name pointing
+            # at un-persisted blocks — losing every outstanding request
+            os.fsync(f.fileno())
         self._f.close()
         os.replace(tmp, self.path)
         self._f = open(self.path, "a", encoding="utf-8")

@@ -27,8 +27,11 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core import telemetry
 from ..core.schema import Table
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from ..utils.fault_tolerance import Overloaded
+from ..utils.faults import fault_point
 from .journal import EpochJournal
 
 __all__ = ["CachedRequest", "WorkerServer", "ServingServer", "ServiceInfo",
@@ -68,6 +71,9 @@ class CachedRequest:
     handler_gone: threading.Event = field(default_factory=threading.Event)
     # journal-recovered after a restart: no client holds this exchange
     recovered: bool = False
+    # absolute time.monotonic() budget from the X-Deadline-Ms header; an
+    # expired request is failed fast at batch admission, never computed
+    deadline: Optional[float] = None
 
 
 class WorkerServer:
@@ -78,10 +84,20 @@ class WorkerServer:
 
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  path: str = "/", handler_timeout: float = 30.0,
-                 journal: Optional["EpochJournal"] = None):
+                 journal: Optional["EpochJournal"] = None,
+                 max_queue: Optional[int] = 1024):
         self.name = name
         self.path = path if path.startswith("/") else "/" + path
+        # the queue object stays unbounded: requeue/recover/journal-replay
+        # re-insert ALREADY-ACCEPTED requests and must never block or drop.
+        # The bound is enforced at HTTP admission (do_POST sheds with 503 +
+        # Retry-After once qsize reaches max_queue) — bounded by default so
+        # a stalled consumer can't grow the queue without limit.
         self.queue: "Queue[CachedRequest]" = Queue()
+        self.max_queue = None if max_queue is None else int(max_queue)
+        # draining: admission sheds everything while held exchanges finish
+        # (the graceful half of ServingServer.stop())
+        self._draining = threading.Event()
         self.routing: Dict[str, CachedRequest] = {}
         self._routing_lock = threading.Lock()
         self.handler_timeout = handler_timeout
@@ -127,13 +143,35 @@ class WorkerServer:
                     self.send_error(501, "chunked transfer not supported")
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                # read the body BEFORE any early reply: unread bytes would
+                # frame as the next request on this keep-alive connection
                 body = self.rfile.read(length) if length else b""
+                if outer._draining.is_set() or (
+                        outer.max_queue is not None
+                        and outer.queue.qsize() >= outer.max_queue):
+                    # load shedding: a bounded queue answers "not now"
+                    # immediately instead of queueing work it can't keep
+                    # up with (admission control; 503 is retryable)
+                    telemetry.incr("serving.shed")
+                    self._reply_bytes(
+                        503, b'{"error": "server overloaded, retry later"}',
+                        {"Retry-After": "1",
+                         "Content-Type": "application/json"})
+                    return
+                deadline = None
+                dl_ms = self.headers.get("X-Deadline-Ms")
+                if dl_ms is not None:
+                    try:
+                        deadline = time.monotonic() + float(dl_ms) / 1000.0
+                    except ValueError:
+                        pass  # malformed budget: treat as no deadline
                 req = CachedRequest(
                     id=uuid.uuid4().hex,
                     request=HTTPRequestData(
                         url=self.path, method="POST",
                         headers=dict(self.headers.items()), entity=body,
                     ),
+                    deadline=deadline,
                 )
                 if outer.journal is not None:
                     outer.journal.log_request(req.id, body,
@@ -158,6 +196,16 @@ class WorkerServer:
                 body = resp.entity or b""
                 self.send_response(resp.status_code)
                 for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_bytes(self, status: int, body: bytes,
+                             headers: Dict[str, str]):
+                """Direct small reply (shed/error) preserving keep-alive."""
+                self.send_response(status)
+                for k, v in headers.items():
                     self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -199,7 +247,14 @@ class WorkerServer:
             def log_message(self, *a):  # quiet
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # a deep listen backlog keeps admission control OURS: a connect
+        # burst must reach the shed check (503 + Retry-After) instead of
+        # dying in the kernel's SYN queue (ThreadingHTTPServer's default
+        # request_queue_size is 5 — connection resets under any burst)
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), Handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name=f"serve-{name}", daemon=True
         )
@@ -212,6 +267,17 @@ class WorkerServer:
     def start(self):
         self._thread.start()
 
+    def begin_drain(self):
+        """Graceful-stop phase 1: new requests shed (503 + Retry-After)
+        while already-accepted work keeps flowing to the consumer."""
+        self._draining.set()
+
+    def drained(self) -> bool:
+        """Nothing queued and no held exchange waiting on a reply."""
+        with self._routing_lock:
+            held = any(not r.done.is_set() for r in self.routing.values())
+        return self.queue.qsize() == 0 and not held
+
     def stop(self):
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -220,22 +286,46 @@ class WorkerServer:
         with self._routing_lock:
             self.routing.pop(request_id, None)
 
+    def _admit(self, req: CachedRequest) -> bool:
+        """Deadline gate at batch admission: an expired request is failed
+        fast (504, no model compute) — the client's budget is already
+        blown, computing the answer would only steal capacity from
+        requests that can still make theirs."""
+        if req.deadline is not None and time.monotonic() >= req.deadline:
+            telemetry.incr("serving.deadline_expired")
+            self.reply_to(req.id, HTTPResponseData(
+                504, "deadline exceeded", {"Content-Type": "application/json"},
+                b'{"error": "deadline exceeded before processing"}'))
+            return False
+        return True
+
     def get_batch(self, max_batch: int, timeout_ms: float,
                   block: bool = True) -> List[CachedRequest]:
         """Drain up to max_batch requests; blocks up to timeout_ms for the
         first one (continuous-batching feed).  `block=False` drains only
-        what is already queued (the microbatch-trigger feed)."""
+        what is already queued (the microbatch-trigger feed).  Requests
+        whose X-Deadline-Ms budget already expired are answered 504 here
+        and never enter the batch."""
         out: List[CachedRequest] = []
         if block:
-            try:
-                out.append(self.queue.get(timeout=timeout_ms / 1000.0))
-            except Empty:
-                return out
+            stop_at = time.monotonic() + timeout_ms / 1000.0
+            while not out:
+                remaining = stop_at - time.monotonic()
+                if remaining <= 0:
+                    return out
+                try:
+                    req = self.queue.get(timeout=remaining)
+                except Empty:
+                    return out
+                if self._admit(req):
+                    out.append(req)
         while len(out) < max_batch:
             try:
-                out.append(self.queue.get_nowait())
+                req = self.queue.get_nowait()
             except Empty:
                 break
+            if self._admit(req):
+                out.append(req)
         return out
 
     def get_epoch_batch(self, max_batch: int, timeout_ms: float,
@@ -445,7 +535,9 @@ class ServingServer:
                  trigger_interval_ms: float = 20.0,
                  journal_path: Optional[str] = None,
                  stream_fn: Optional[Any] = None,
-                 stream_workers: int = 8):
+                 stream_workers: int = 8,
+                 max_queue: Optional[int] = 1024,
+                 drain_timeout_s: float = 5.0):
         if mode not in ("continuous", "microbatch"):
             raise ValueError("mode must be 'continuous' or 'microbatch'")
         if stream_fn is None and (model is None or reply_col is None):
@@ -473,8 +565,10 @@ class ServingServer:
         # journaled-but-unanswered request through the model
         self.journal = (EpochJournal(journal_path)
                         if journal_path is not None else None)
+        self.drain_timeout_s = float(drain_timeout_s)
         self.server = WorkerServer(name, host, port, path,
-                                   journal=self.journal)
+                                   journal=self.journal,
+                                   max_queue=max_queue)
         self._running = threading.Event()
         self._worker: Optional[threading.Thread] = None
         self._supervisor: Optional[threading.Thread] = None
@@ -497,6 +591,11 @@ class ServingServer:
             if not batch:
                 self.server.commit(epoch)  # empty epochs GC immediately
                 continue
+            # chaos hook: an InjectedCrash here escapes except Exception
+            # below and kills the consumer thread mid-batch — exactly the
+            # death the supervisor + epoch replay must absorb (the batch
+            # is already recorded in the epoch history)
+            fault_point("serving.batch_loop")
             if self.stream_fn is not None:
                 # rows come straight from each request's JSON body: the
                 # columnar parse would coerce types batch-dependently (a
@@ -559,6 +658,15 @@ class ServingServer:
         try:
             it = iter(self.stream_fn(row))
             first = next(it, None)
+        except Overloaded as e:
+            # bounded-intake rejection (e.g. ContinuousBatcher.submit with
+            # max_pending): shed, don't error — clients retry 503s
+            telemetry.incr("serving.shed")
+            self.server.reply_to(request_id, HTTPResponseData(
+                503, "overloaded", {"Retry-After": "1",
+                                    "Content-Type": "application/json"},
+                json.dumps({"error": str(e)}).encode()))
+            return
         except Exception as e:  # noqa: BLE001 — pre-stream failure: real 500
             self.stats["errors"] += 1
             self.server.reply_to(request_id, HTTPResponseData(
@@ -610,7 +718,17 @@ class ServingServer:
         self._supervisor.start()
         return self.service_info
 
-    def stop(self):
+    def stop(self, drain: bool = True):
+        """Graceful by default: shed new arrivals (503 + Retry-After),
+        let the consumer answer everything already accepted (bounded by
+        `drain_timeout_s`), then tear the threads down.  `drain=False`
+        is the hard stop (process-death simulation; the journal replays
+        what was lost)."""
+        if drain and self._running.is_set():
+            self.server.begin_drain()
+            stop_at = time.monotonic() + self.drain_timeout_s
+            while time.monotonic() < stop_at and not self.server.drained():
+                time.sleep(0.01)
         self._running.clear()
         if self._worker is not None:
             self._worker.join(timeout=5)

@@ -1,3 +1,12 @@
-from .fault_tolerance import retry_with_timeout, retry_with_backoff
+from .fault_tolerance import Overloaded, retry_with_timeout, retry_with_backoff
+from .faults import (
+    FAULTS,
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    InjectedCrash,
+    InjectedFault,
+    fault_point,
+)
 from .cluster import ClusterInfo, cluster_info
 from .async_utils import bounded_parallel_map

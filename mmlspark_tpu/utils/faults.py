@@ -1,0 +1,185 @@
+"""Deterministic fault injection: named fault points + seeded plans.
+
+The reference system's resilience machinery (FaultToleranceUtils.scala
+retryWithTimeout, HTTPSourceV2 historyQueues/recoveredPartitions replay)
+was only ever *exercised* by production incidents; this module makes the
+failure paths testable on demand.  Production code declares **named fault
+points** (`fault_point("feed.device_put")`) at every site that can fail
+in the field — a transfer, a batch-loop tick, an HTTP send, a training
+step.  By default a fault point is a no-op costing one attribute load and
+one branch.  Tests (and `tools/chaos_soak.py`) arm a seeded `FaultPlan`
+through the process-global injector:
+
+    from mmlspark_tpu.utils.faults import FAULTS, FaultPlan, InjectedFault
+
+    plan = FaultPlan(seed=7)
+    plan.on("feed.device_put", probability=0.15, max_failures=20)
+    plan.on("serving.batch_loop", nth={3, 9}, error=InjectedCrash)
+    with FAULTS.arm(plan):
+        ...drive traffic...
+    assert FAULTS.fires["feed.device_put"] > 0
+
+Determinism: each point draws from its OWN `random.Random` seeded with
+`(plan.seed, point_name)`, so the fire pattern of one point is a pure
+function of how many times *that point* was reached — concurrency or
+reordering elsewhere cannot shift it.  `nth` plans fire on exact call
+indices (0-based) for fully scripted scenarios.
+
+Every fire increments `core.telemetry` counter `faults.injected` (and
+`faults.injected.<point>`), so chaos runs leave the same audit trail as
+real failures.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterable, Optional, Set
+
+__all__ = ["InjectedFault", "InjectedCrash", "FaultRule", "FaultPlan",
+           "FaultInjector", "FAULTS", "fault_point"]
+
+
+class InjectedFault(Exception):
+    """A recoverable injected failure (derives from Exception, so it rides
+    the same handling as a real transfer/HTTP/model error)."""
+
+
+class InjectedCrash(BaseException):
+    """An injected *crash*: escapes `except Exception` handlers, killing
+    the consumer thread the way a real process/task death would — the
+    supervisor/replay path must recover, not the error path."""
+
+
+class FaultRule:
+    """When one named point fires.
+
+    probability: per-call chance drawn from the point's seeded RNG.
+    nth: exact 0-based call indices that fire (overrides probability).
+    latency_s: sleep injected on fire (None/0 = none) — models a stall
+        rather than (or in addition to) an error.
+    error: exception CLASS raised on fire; None = latency-only fault.
+    max_failures: total fires allowed (None = unlimited).
+    """
+
+    def __init__(self, probability: float = 0.0,
+                 nth: Optional[Iterable[int]] = None,
+                 latency_s: float = 0.0,
+                 error: Optional[type] = InjectedFault,
+                 max_failures: Optional[int] = None):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError(f"probability must be in [0, 1], got {probability}")
+        self.probability = float(probability)
+        self.nth: Optional[Set[int]] = (None if nth is None
+                                        else {int(i) for i in nth})
+        self.latency_s = float(latency_s)
+        self.error = error
+        self.max_failures = (None if max_failures is None
+                             else int(max_failures))
+
+
+class FaultPlan:
+    """A seeded set of rules, armed via FAULTS.arm(plan)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.rules: Dict[str, FaultRule] = {}
+
+    def on(self, point: str,
+           probability: float = 0.0,
+           nth: Optional[Iterable[int]] = None,
+           latency_s: float = 0.0,
+           error: Optional[type] = InjectedFault,
+           max_failures: Optional[int] = None) -> "FaultPlan":
+        self.rules[point] = FaultRule(probability, nth, latency_s, error,
+                                      max_failures)
+        return self
+
+
+class FaultInjector:
+    """Process-global fault-point evaluator.
+
+    `calls` counts every arrival at an armed point; `fires` counts
+    injections.  Both are plain dicts snapshot-readable after a run.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan: Optional[FaultPlan] = None
+        self._rngs: Dict[str, "object"] = {}
+        self.calls: Dict[str, int] = {}
+        self.fires: Dict[str, int] = {}
+        # the fast-path flag read (unlocked) by fault_point(); plain
+        # attribute reads/writes are atomic under the GIL
+        self.active = False
+
+    @contextlib.contextmanager
+    def arm(self, plan: FaultPlan):
+        """Install `plan` for the duration of the block.  Non-reentrant:
+        one plan at a time keeps the seeded draws deterministic."""
+        import random
+
+        with self._lock:
+            if self._plan is not None:
+                raise RuntimeError("a fault plan is already armed")
+            self._plan = plan
+            # str seeds hash via sha512 (stable across processes; a tuple
+            # seed would ride the randomized str hash)
+            self._rngs = {p: random.Random(f"{plan.seed}:{p}")
+                          for p in plan.rules}
+            self.calls = {p: 0 for p in plan.rules}
+            self.fires = {p: 0 for p in plan.rules}
+            self.active = True
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._plan = None
+                self._rngs = {}
+                self.active = False
+
+    def check(self, point: str):
+        """Evaluate an armed point; raises the rule's error on fire."""
+        with self._lock:
+            plan = self._plan
+            if plan is None:
+                return
+            rule = plan.rules.get(point)
+            if rule is None:
+                return
+            idx = self.calls.get(point, 0)
+            self.calls[point] = idx + 1
+            if rule.max_failures is not None and \
+                    self.fires.get(point, 0) >= rule.max_failures:
+                return
+            if rule.nth is not None:
+                fire = idx in rule.nth
+            else:
+                fire = (rule.probability > 0.0
+                        and self._rngs[point].random() < rule.probability)
+            if not fire:
+                return
+            self.fires[point] = self.fires.get(point, 0) + 1
+            latency = rule.latency_s
+            error = rule.error
+        # outside the lock: a sleeping fault must not serialize every
+        # other point in the process
+        from ..core import telemetry
+
+        telemetry.incr("faults.injected")
+        telemetry.incr(f"faults.injected.{point}")
+        if latency > 0:
+            time.sleep(latency)
+        if error is not None:
+            raise error(f"injected fault at {point!r} (call #{idx})")
+
+
+FAULTS = FaultInjector()
+
+
+def fault_point(name: str):
+    """Declare a named fault point.  No-op unless a plan is armed — the
+    disarmed cost is one attribute read and one branch, cheap enough for
+    per-transfer and per-tick hot paths."""
+    if FAULTS.active:
+        FAULTS.check(name)

@@ -53,6 +53,41 @@ def pytest_configure(config):
         "markers",
         "slow: long-running / wall-clock-sensitive; excluded from the "
         "tier-1 gate (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic seeded fault-injection tests (utils.faults); "
+        "fast and tier-1 — chaos here means reproducible, not flaky")
+
+
+# thread-name prefixes owned by serving/batching infrastructure; a test
+# that returns while one of these is still alive has leaked a server or
+# batcher (a later test inherits its port contention / fault plan /
+# telemetry noise).  Only non-daemon threads fail the test outright:
+# daemon pool threads (ThreadPoolExecutor) park harmlessly.
+_INFRA_PREFIXES = ("serve-", "serving-", "continuous-batcher", "stream-")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_serving_threads(request):
+    import threading
+    import time
+
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = time.monotonic() + 2.0  # grace: stop() joins may lag
+    while time.monotonic() < deadline:
+        leaked = [
+            t for t in threading.enumerate()
+            if t.ident not in before and t.is_alive() and not t.daemon
+            and t.name.startswith(_INFRA_PREFIXES)
+        ]
+        if not leaked:
+            return
+        time.sleep(0.05)
+    pytest.fail(
+        f"test leaked non-daemon serving threads: "
+        f"{[t.name for t in leaked]} — call .stop() on every "
+        "WorkerServer/ServingServer/ContinuousBatcher the test starts")
 
 
 @pytest.fixture

@@ -169,6 +169,78 @@ def test_torn_tail_line_ignored(tmp_path):
     assert [r[0] for r in rec] == ["a"]
 
 
+def test_corrupt_trailing_records_tolerated(tmp_path):
+    """A torn tail that still PARSES (non-dict JSON, dict without an id,
+    garbage base64 payload) must be skipped, not crash recovery — every
+    intact record before it is salvaged."""
+    jpath = str(tmp_path / "journal.jsonl")
+    j = EpochJournal(jpath)
+    j.log_request("a", b'{"x": 1}')
+    j.log_request("b", b'{"x": 2}')
+    j.close()
+    with open(jpath, "a", encoding="utf-8") as f:
+        f.write('[1, 2]\n')                       # valid JSON, not a dict
+        f.write('{"t": "req"}\n')                 # dict, no id
+        f.write('{"t": "rep"}\n')                 # reply without id
+        f.write('{"t": "req", "id": "c", "e": "!!!notb64"}\n')
+        f.write('null\n')
+    rec = EpochJournal(jpath).recovered_requests()
+    assert sorted(r[0] for r in rec) == ["a", "b"]
+
+
+def test_crash_mid_compact_never_loses_requests(tmp_path, monkeypatch):
+    """Kill the process at either compaction crash window — before the
+    atomic rename (tmp written, original untouched) and after it (new
+    file in place) — and reopen: the unreplied request is still there."""
+    import os as _os
+
+    # window 1: crash BEFORE os.replace — original journal untouched
+    jpath = str(tmp_path / "j1.jsonl")
+    j = EpochJournal(jpath, compact_every=2)
+    j.log_request("keep", b'{"k": 1}')
+    j.log_request("dead", b'{"d": 1}')
+    j.log_reply("dead")
+
+    real_replace = _os.replace
+
+    def crash_replace(src, dst):
+        raise OSError("simulated crash before rename")
+
+    monkeypatch.setattr("mmlspark_tpu.serving.journal.os.replace",
+                        crash_replace)
+    try:
+        j.flush()  # triggers compaction, "crashes"
+    except OSError:
+        pass
+    monkeypatch.setattr("mmlspark_tpu.serving.journal.os.replace",
+                        real_replace)
+    rec = EpochJournal(jpath).recovered_requests()
+    assert [r[0] for r in rec] == ["keep"]
+
+    # window 2: crash right AFTER os.replace — compacted file in place,
+    # old handle dead, process never reopened the journal
+    jpath2 = str(tmp_path / "j2.jsonl")
+    j2 = EpochJournal(jpath2, compact_every=2)
+    j2.log_request("keep2", b'{"k": 2}')
+    j2.log_request("dead2", b'{"d": 2}')
+    j2.log_reply("dead2")
+
+    def replace_then_crash(src, dst):
+        real_replace(src, dst)
+        raise OSError("simulated crash after rename")
+
+    monkeypatch.setattr("mmlspark_tpu.serving.journal.os.replace",
+                        replace_then_crash)
+    try:
+        j2.flush()
+    except OSError:
+        pass
+    monkeypatch.setattr("mmlspark_tpu.serving.journal.os.replace",
+                        real_replace)
+    rec2 = EpochJournal(jpath2).recovered_requests()
+    assert [r[0] for r in rec2] == ["keep2"]
+
+
 # ------------------------------------------------ ServingServer e2e
 
 

@@ -1,0 +1,211 @@
+"""Chaos soak: live HTTP serving under a seeded fault plan.
+
+Runs a real ServingServer (model compute routed through DeviceFeed, so
+host->device transfers cross the `feed.device_put` fault point) while a
+burst of concurrent clients posts requests, with faults injected per a
+deterministic seeded plan:
+
+  * `feed.device_put` fails with >= 10% probability (bounded by
+    `max_failures`) — exercising the transfer retry ladder and the
+    pipelined->unpipelined degrade;
+  * `serving.batch_loop` takes exact-index `InjectedCrash`es — killing
+    the consumer thread mid-batch so the supervisor + epoch replay path
+    must absorb them;
+  * the intake queue is small, so the burst sheds (503 + Retry-After);
+  * a few requests carry an already-expired `X-Deadline-Ms` and must be
+    failed fast with 504, never computed.
+
+The soak asserts the robustness invariant end to end: EVERY accepted
+request is answered exactly once with the correct payload; shed requests
+get 503 + Retry-After; deadline-expired get 504; nothing is lost (every
+client gets exactly one response) and nothing is duplicated (each
+request id's reply observed once).  See docs/robustness.md.
+
+Usage: python tools/chaos_soak.py [--seed N] [--requests N] [--json]
+Also importable (tests/test_chaos.py): run_soak(...) returns the summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _make_model():
+    """Transformer whose compute goes host->device through DeviceFeed."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+    from mmlspark_tpu.io.feed import DeviceFeed
+
+    feed = DeviceFeed()
+
+    def fn(table):
+        v = np.asarray(table["v"], np.float32)
+        dv = feed.put(v)                 # crosses feed.device_put
+        y = np.asarray(jnp.asarray(dv) * 3.0)
+        return table.with_column("y", y.astype(np.int64))
+
+    model = LambdaTransformer(fn)
+    model._soak_feed = feed              # expose degrade flag to the report
+    return model
+
+
+def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
+             transfer_fail_p: float = 0.2, crash_nth=(1, 4, 8),
+             n_expired: int = 3) -> dict:
+    """One seeded soak; returns a JSON-able summary dict.  Raises
+    AssertionError if any robustness invariant is violated."""
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.io.http.clients import send_request
+    from mmlspark_tpu.io.http.schema import to_http_request
+    from mmlspark_tpu.serving.server import ServingServer
+    from mmlspark_tpu.utils.faults import FAULTS, FaultPlan, InjectedCrash
+
+    telemetry.reset_counters()
+    model = _make_model()
+    srv = ServingServer(
+        model, reply_col="y", name="chaos-soak", path="/soak",
+        input_schema=["v"], max_batch=4, batch_timeout_ms=20.0,
+        # every crash costs one attempt on the whole batch: the budget
+        # must cover len(crash_nth) replays of an unlucky request plus
+        # the original try, or a thrice-crashed request 500s
+        max_attempts=len(crash_nth) + 2,
+        max_queue=max_queue)
+    plan = (FaultPlan(seed=seed)
+            .on("feed.device_put", probability=transfer_fail_p,
+                max_failures=max(4, n_requests // 4))
+            .on("serving.batch_loop", nth=list(crash_nth),
+                error=InjectedCrash))
+
+    results: list = [None] * (n_requests + n_expired)
+
+    def post(url, payload, i, headers=None):
+        try:
+            results[i] = send_request(
+                to_http_request(url, payload, headers=headers), timeout=30)
+        except Exception as e:  # noqa: BLE001 — a lost reply must surface
+            results[i] = e
+
+    # the injected consumer crashes are EXPECTED thread deaths: keep
+    # their tracebacks out of the report (and out of pytest's
+    # unhandled-thread-exception warnings); anything else still prints
+    prev_hook = threading.excepthook
+
+    def quiet_injected(args):
+        if not issubclass(args.exc_type, InjectedCrash):
+            prev_hook(args)
+
+    threading.excepthook = quiet_injected
+    info = srv.start()
+    try:
+        with FAULTS.arm(plan):
+            threads = [
+                threading.Thread(target=post, daemon=True,
+                                 args=(info.url, {"v": i}, i))
+                for i in range(n_requests)
+            ]
+            # waves, not one thundering herd: the consumer must get a
+            # chance to both COMPUTE (200s) and shed (503s) — a single
+            # instantaneous burst just fills the queue once and sheds
+            # everything else, proving only the shed path
+            for w in range(0, n_requests, 8):
+                for t in threads[w:w + 8]:
+                    t.start()
+                time.sleep(0.08)
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads), \
+                "client thread still waiting: a reply was lost"
+            # already-expired deadlines AFTER the burst (the drained
+            # queue admits them, so the 504 path — not the 503 shed —
+            # must be the thing that stops them being computed)
+            for j in range(n_expired):
+                post(info.url, {"v": -1}, n_requests + j,
+                     headers={"X-Deadline-Ms": "0"})
+            srv.stop()  # graceful drain: no accepted request stranded
+    finally:
+        threading.excepthook = prev_hook
+        if srv._running.is_set():
+            srv.stop(drain=False)
+
+    # ---- invariants ----------------------------------------------------
+    lost = [i for i, r in enumerate(results) if r is None]
+    errors = [(i, r) for i, r in enumerate(results)
+              if isinstance(r, Exception)]
+    assert not lost and not errors, \
+        f"lost replies: {lost}, transport errors: {errors}"
+    ok = [i for i in range(n_requests) if results[i].status_code == 200]
+    shed = [i for i in range(n_requests) if results[i].status_code == 503]
+    other = [(i, results[i].status_code) for i in range(n_requests)
+             if results[i].status_code not in (200, 503)]
+    assert not other, f"unexpected statuses (accepted but not answered " \
+                      f"OK, or mis-shed): {other}"
+    # every ACCEPTED request answered exactly once, with the right value
+    # (the client socket gives at-most-once; the payload check proves the
+    # reply is THIS request's, i.e. replay never cross-wired ids)
+    for i in ok:
+        got = results[i].json()["y"]
+        assert got == 3 * i, f"request {i}: wrong payload {got}"
+    for i in shed:
+        ra = (results[i].headers.get("Retry-After")
+              or results[i].headers.get("retry-after"))
+        assert ra is not None, f"shed request {i} missing Retry-After"
+    for j in range(n_expired):
+        r = results[n_requests + j]
+        assert r.status_code == 504, \
+            f"expired-deadline request got {r.status_code}, want 504"
+    fires = dict(FAULTS.fires)
+    assert fires.get("serving.batch_loop", 0) >= len(crash_nth), \
+        "batch-loop crashes did not all fire"
+    assert fires.get("feed.device_put", 0) > 0, \
+        "no transfer faults fired — the soak proved nothing"
+
+    return {
+        "seed": seed,
+        "requests": n_requests + n_expired,
+        "answered_200": len(ok),
+        "shed_503": len(shed),
+        "deadline_504": n_expired,
+        "lost": 0,
+        "duplicated": 0,
+        "feed_degraded": bool(model._soak_feed.degraded),
+        "faults_fired": fires,
+        "recoveries": srv.stats["recoveries"],
+        "replayed": srv.stats["replayed"],
+        "counters": telemetry.counters(),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--max-queue", type=int, default=8)
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON object")
+    args = ap.parse_args(argv)
+    summary = run_soak(seed=args.seed, n_requests=args.requests,
+                       max_queue=args.max_queue)
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(f"chaos soak OK: {summary['answered_200']} answered, "
+              f"{summary['shed_503']} shed (503), "
+              f"{summary['deadline_504']} deadline-expired (504), "
+              f"0 lost, 0 duplicated; faults fired: "
+              f"{summary['faults_fired']}; "
+              f"recoveries={summary['recoveries']} "
+              f"replayed={summary['replayed']} "
+              f"feed_degraded={summary['feed_degraded']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
