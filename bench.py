@@ -366,6 +366,15 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     # under device compute, and the host-side stall budget — these are
     # what distinguish "the link is slow" from "the feed is serializing"
     feed = FeedTelemetry.summarize(FEED_TELEMETRY.delta(feed_since))
+    # the registry view of the same run: per-transfer latency tail off the
+    # io.feed.transfer.latency histogram (summarize's counters are totals
+    # only — the p95 is what catches a bimodal link)
+    from mmlspark_tpu.core import telemetry as core_telemetry
+
+    obs = core_telemetry.export_snapshot(include_spans=False)
+    feed_hist = obs["histograms"].get("io.feed.transfer.latency")
+    feed_p95_ms = (round(feed_hist["p95"] * 1e3, 3)
+                   if feed_hist and feed_hist["p95"] is not None else None)
 
     out = {
         "value": round(e2e_ips, 1),
@@ -375,6 +384,7 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
         "stall_s": feed["stall_s"],
         "feed_gbps": feed["h2d_gbps"],
         "feed_transfer_calls": feed["transfer_calls"],
+        "feed_transfer_p95_ms": feed_p95_ms,
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
     }

@@ -1,0 +1,109 @@
+"""Unified observability layer: records, counters, gauges, histograms,
+spans, and exposition — one package, one registry.
+
+Grown from the single-module `core/telemetry.py` (flat event counters +
+verb records); the historical surface is preserved verbatim:
+
+* ``incr`` / ``counters`` / ``reset_counters`` — the PR-4 event-counter
+  ledger, now backed by :data:`metrics.REGISTRY` so every counter a
+  fault/shed/breaker path bumps shows up in ``/metrics`` and
+  ``export_snapshot()`` with zero changes at the call sites.
+* ``log_verb`` / ``recent_records`` / ``clear_records`` — stage-verb
+  JSON records (:mod:`.records`).
+* ``StopWatch`` — re-export of the ONE canonical
+  :class:`mmlspark_tpu.utils.stopwatch.StopWatch` (the duplicate that
+  lived here was merged into it; identity is pinned by tests).
+
+New surface (see docs/observability.md):
+
+* spans — ``span()``, ``use_trace()``, ``record_span()``,
+  ``trace_headers()`` / ``extract_trace()`` for X-Trace-Id propagation,
+  ``get_trace()`` / ``span_tree()`` behind ``/trace/<id>``.
+* metrics — ``histogram(name)`` / ``gauge(name)`` on the process
+  registry; names follow ``layer.component.metric`` and must be
+  declared in :data:`metrics.DECLARED_METRICS` (CI-linted).
+* exposition — ``render_prometheus()`` (``/metrics``),
+  ``export_snapshot()`` (bench / chaos_soak / obs_report).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ...utils.stopwatch import StopWatch
+from .metrics import (
+    BYTE_BUCKETS,
+    DECLARED_METRICS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    default_buckets,
+    is_declared,
+)
+from .records import clear_records, log_verb, logger, recent_records
+from .spans import (
+    clear_spans,
+    current_context,
+    current_trace_id,
+    extract_trace,
+    get_trace,
+    recent_spans,
+    record_span,
+    span,
+    span_tree,
+    trace_headers,
+    use_trace,
+)
+from .exposition import (
+    export_snapshot,
+    format_latency_table,
+    format_span_tree,
+    render_prometheus,
+)
+
+__all__ = [
+    # counters (historical surface, registry-backed)
+    "incr", "counters", "reset_counters",
+    # records
+    "log_verb", "recent_records", "clear_records", "logger",
+    # stopwatch
+    "StopWatch",
+    # metrics
+    "REGISTRY", "MetricsRegistry", "Gauge", "Histogram", "gauge",
+    "histogram", "default_buckets", "BYTE_BUCKETS", "DECLARED_METRICS",
+    "is_declared",
+    # spans
+    "span", "record_span", "use_trace", "current_context",
+    "current_trace_id", "trace_headers", "extract_trace", "get_trace",
+    "span_tree", "recent_spans", "clear_spans",
+    # exposition
+    "render_prometheus", "export_snapshot", "format_span_tree",
+    "format_latency_table",
+]
+
+
+def incr(name: str, n: int = 1) -> None:
+    """Bump a named event counter (dotted names: 'serving.shed')."""
+    REGISTRY.incr(name, n)
+
+
+def counters(prefix: Optional[str] = None) -> Dict[str, int]:
+    """Snapshot the event counters, optionally filtered by name prefix."""
+    return REGISTRY.counter_values(prefix)
+
+
+def reset_counters(prefix: Optional[str] = None) -> None:
+    """Zero the counters (tests); with `prefix`, only matching names."""
+    REGISTRY.reset_counters(prefix)
+
+
+def gauge(name: str) -> Gauge:
+    """The process-registry gauge `name` (created on first touch)."""
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str, boundaries: Optional[Sequence[float]] = None,
+              **labels: str) -> Histogram:
+    """The process-registry histogram `name` (first touch fixes the
+    bucket ladder for the whole labeled family)."""
+    return REGISTRY.histogram(name, boundaries, **labels)

@@ -1,0 +1,289 @@
+"""Metric primitives behind one process-wide registry.
+
+Three instrument kinds, Prometheus-shaped (the exposition convention):
+
+* **counters** — the PR-4 event ledger (`incr("serving.shed")`),
+  monotonic ints.  Kept as a plain dict under one lock: `incr` is called
+  from every fault/retry/shed path and must stay a few hundred ns.
+* **gauges** — last-written values (queue depths, overlap fractions,
+  examples/sec).  `gauge(name).set(v)` / `.inc()`.
+* **histograms** — fixed log-spaced buckets, LOCK-STRIPED: each
+  observing thread hashes onto one of `_STRIPES` independent
+  (lock, counts, sum) shards so the serving hot path never serializes
+  on a single histogram lock; snapshots merge the stripes.
+
+Naming convention: ``layer.component.metric`` (e.g.
+``serving.request.latency``, ``io.feed.transfer.bytes``).  Every STATIC
+name instrumented anywhere in the tree must appear in
+``DECLARED_METRICS`` below — `tools/ci.py metrics-lint` greps call sites
+and fails on undeclared literals, so a typo'd metric name cannot
+silently record into a parallel series nobody scrapes.  Dynamic
+per-entity suffixes (``faults.injected.<point>``,
+``circuit.open.<host>``) are valid when their PREFIX is declared.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["DECLARED_METRICS", "is_declared", "Gauge", "Histogram",
+           "MetricsRegistry", "REGISTRY", "default_buckets",
+           "BYTE_BUCKETS"]
+
+# ---------------------------------------------------------------------------
+# The declared-name table: every static metric/counter name in the tree.
+# tools/ci.py `metrics-lint` enforces that instrumented literals resolve
+# here (exact match, or prefix match for per-entity families).
+# ---------------------------------------------------------------------------
+DECLARED_METRICS: Dict[str, str] = {
+    # -- counters (telemetry.incr): the resilience event ledger (PR 4)
+    "serving.shed": "counter",
+    "serving.deadline_expired": "counter",
+    "batcher.shed": "counter",
+    "batcher.deadline_expired": "counter",
+    "feed.transfer_retry": "counter",
+    "feed.degraded": "counter",
+    "circuit.open": "counter",            # + .<breaker-name> variants
+    "circuit.closed": "counter",
+    "circuit.half_open_probe": "counter",
+    "faults.injected": "counter",         # + .<fault-point> variants
+    "training.autosave": "counter",
+    "training.resume": "counter",
+    # -- histograms
+    "serving.request.latency": "histogram",
+    "serving.batch.fill": "histogram",
+    "serving.batcher.batch_fill": "histogram",
+    "io.feed.transfer.latency": "histogram",
+    "io.feed.transfer.bytes": "histogram",
+    "io.http.request.latency": "histogram",
+    "models.training.step_latency": "histogram",
+    # -- gauges
+    "serving.queue.depth": "gauge",
+    "serving.batcher.queue_depth": "gauge",
+    "io.feed.degraded_engines": "gauge",
+    "io.feed.overlap_frac": "gauge",
+    "io.feed.stall_s": "gauge",
+    "models.training.examples_per_sec": "gauge",
+}
+
+
+def is_declared(name: str) -> bool:
+    """Exact member of the table, or a dynamic per-entity child of one
+    (``faults.injected.feed.device_put`` under ``faults.injected``)."""
+    if name in DECLARED_METRICS:
+        return True
+    return any(name.startswith(d + ".") for d in DECLARED_METRICS)
+
+
+# half-decade log spacing, 1 µs .. 1000 s: one default ladder covers
+# everything timed in seconds, from a coalesced device_put to a cold
+# XLA compile inside a serving tick
+def default_buckets() -> Tuple[float, ...]:
+    return tuple(10.0 ** (-6 + i / 2.0) for i in range(19))
+
+
+# power-of-4 spacing, 64 B .. 1 GiB: the transfer-size ladder
+BYTE_BUCKETS: Tuple[float, ...] = tuple(float(64 * 4 ** i) for i in range(13))
+
+_STRIPES = 8
+
+
+class Gauge:
+    """Last-written value; `inc`/`dec` for up-down counts."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _Stripe:
+    __slots__ = ("lock", "counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.lock = threading.Lock()
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram:
+    """Fixed-boundary histogram, lock-striped across observer threads.
+
+    `boundaries` are the bucket UPPER edges (ascending); observations
+    above the last edge land in the implicit +Inf bucket.  An
+    observation exactly ON an edge counts into that edge's bucket
+    (Prometheus `le` semantics — bucket i holds v <= boundaries[i]).
+    """
+
+    def __init__(self, name: str,
+                 boundaries: Optional[Sequence[float]] = None):
+        self.name = name
+        bs = tuple(boundaries) if boundaries is not None else default_buckets()
+        if list(bs) != sorted(bs) or len(set(bs)) != len(bs):
+            raise ValueError(f"histogram boundaries must be strictly "
+                             f"ascending, got {bs}")
+        self.boundaries: Tuple[float, ...] = bs
+        self._stripes = [_Stripe(len(bs) + 1) for _ in range(_STRIPES)]
+
+    def observe(self, value: float) -> None:
+        # le semantics: first boundary >= value (bisect_left: an exact
+        # edge hit stays in that edge's bucket)
+        i = bisect.bisect_left(self.boundaries, value)
+        s = self._stripes[threading.get_ident() % _STRIPES]
+        with s.lock:
+            s.counts[i] += 1
+            s.sum += value
+            s.count += 1
+
+    # ---- read side -----------------------------------------------------
+    def _merged(self) -> Tuple[List[int], float, int]:
+        counts = [0] * (len(self.boundaries) + 1)
+        total_sum, total_n = 0.0, 0
+        for s in self._stripes:
+            with s.lock:
+                for i, c in enumerate(s.counts):
+                    counts[i] += c
+                total_sum += s.sum
+                total_n += s.count
+        return counts, total_sum, total_n
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile (q in [0, 1]); None when empty.
+        Values in the +Inf bucket report the last finite edge — a
+        histogram quantile can never resolve beyond its ladder."""
+        counts, _s, n = self._merged()
+        if n == 0:
+            return None
+        target = q * n
+        cum = 0.0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target and c > 0:
+                if i >= len(self.boundaries):
+                    return self.boundaries[-1]
+                lo = self.boundaries[i - 1] if i > 0 else 0.0
+                hi = self.boundaries[i]
+                frac = (target - (cum - c)) / c
+                return lo + (hi - lo) * frac
+        return self.boundaries[-1]
+
+    def snapshot(self) -> Dict[str, object]:
+        counts, total_sum, n = self._merged()
+        cum, buckets = 0, []
+        for i, le in enumerate(self.boundaries):
+            cum += counts[i]
+            buckets.append((le, cum))
+        buckets.append((float("inf"), n))
+        return {
+            "count": n,
+            "sum": total_sum,
+            "buckets": buckets,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class MetricsRegistry:
+    """One process-wide home for every instrument.
+
+    Counters keep the exact PR-4 dict semantics (incr / counters /
+    reset_counters) so the existing chaos assertions hold; gauges and
+    histograms are create-on-first-touch keyed by (name, labels)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                          Histogram] = {}
+        # the bucket ladder is fixed per NAME: every labeled child of
+        # one histogram family must be mergeable/comparable
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
+
+    # ---- counters ------------------------------------------------------
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter_values(self, prefix: Optional[str] = None) -> Dict[str, int]:
+        with self._lock:
+            if prefix is None:
+                return dict(self._counters)
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
+
+    def reset_counters(self, prefix: Optional[str] = None) -> None:
+        with self._lock:
+            if prefix is None:
+                self._counters.clear()
+            else:
+                for k in [k for k in self._counters if k.startswith(prefix)]:
+                    del self._counters[k]
+
+    # ---- gauges --------------------------------------------------------
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def gauge_values(self) -> Dict[str, float]:
+        with self._lock:
+            gauges = list(self._gauges.values())
+        return {g.name: g.value for g in gauges}
+
+    # ---- histograms ----------------------------------------------------
+    def histogram(self, name: str,
+                  boundaries: Optional[Sequence[float]] = None,
+                  **labels: str) -> Histogram:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                bs = self._hist_buckets.get(name)
+                if bs is None:
+                    bs = (tuple(boundaries) if boundaries is not None
+                          else default_buckets())
+                    self._hist_buckets[name] = bs
+                h = self._hists[key] = Histogram(name, bs)
+            return h
+
+    def histograms(self) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                                 Histogram]:
+        with self._lock:
+            return dict(self._hists)
+
+    def reset_all(self) -> None:
+        """Tests only: counters, gauges, and histograms back to empty."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._hist_buckets.clear()
+
+
+REGISTRY = MetricsRegistry()

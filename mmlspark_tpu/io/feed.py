@@ -129,6 +129,12 @@ class FeedTelemetry:
             "h2d_gbps": (round(d["bytes_moved"] / d["transfer_s"] / 1e9, 4)
                          if d.get("transfer_s", 0) > 0 else None),
         }
+        # mirror the derived numbers onto the registry so /metrics and
+        # export_snapshot() carry the latest feed summary
+        core_telemetry.gauge("io.feed.stall_s").set(out["stall_s"])
+        if out["overlap_frac"] is not None:
+            core_telemetry.gauge("io.feed.overlap_frac").set(
+                out["overlap_frac"])
         return out
 
 
@@ -184,6 +190,23 @@ class DeviceFeed:
         self._rings: Dict[Any, List[_RingSlot]] = {}
         self._ring_pos: Dict[Any, int] = {}
         self._unpackers: Dict[Any, Callable] = {}
+        # materialize the degraded-engines gauge at 0 so a /metrics scrape
+        # sees the series before (and whether or not) anything degrades
+        core_telemetry.gauge("io.feed.degraded_engines")
+
+    def _obs_transfer(self, nbytes: float, dt: float, chunks: int) -> None:
+        """Per-transfer registry instrumentation: latency + size
+        histograms always; a `feed.transfer` child span when the calling
+        thread is inside a trace (a served request's batch tick), so the
+        device upload shows up in that request's `/trace/<id>` tree."""
+        core_telemetry.histogram("io.feed.transfer.latency").observe(dt)
+        core_telemetry.histogram(
+            "io.feed.transfer.bytes",
+            boundaries=core_telemetry.BYTE_BUCKETS).observe(nbytes)
+        ctx = core_telemetry.current_context()
+        if ctx is not None:
+            core_telemetry.record_span("feed.transfer", ctx, dt,
+                                       bytes=int(nbytes), chunks=chunks)
 
     # ---- guarded transfer ----------------------------------------------
     def _device_put(self, arr, sharding=None):
@@ -210,6 +233,7 @@ class DeviceFeed:
         if not self.degraded:
             self.degraded = True
             core_telemetry.incr("feed.degraded")
+            core_telemetry.gauge("io.feed.degraded_engines").inc()
             warnings.warn(f"DeviceFeed degraded to unpipelined transfers: {why}",
                           RuntimeWarning, stacklevel=3)
 
@@ -243,9 +267,10 @@ class DeviceFeed:
         out = self._device_put(arr, sharding)
         if block:
             jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
         self.telemetry.add(bytes_moved=arr.nbytes, transfer_calls=1,
-                           transfer_s=time.perf_counter() - t0,
-                           chunks_fed=1, groups=1)
+                           transfer_s=dt, chunks_fed=1, groups=1)
+        self._obs_transfer(arr.nbytes, dt, 1)
         return out
 
     def put_group(self, arrays: Sequence[np.ndarray], shardings=None,
@@ -287,10 +312,12 @@ class DeviceFeed:
         except Exception as e:  # noqa: BLE001 — degrade, then the safe path
             self._degrade(f"packed put_group failed after retries: {e}")
             return tuple(self.put(a, s) for a, s in zip(arrays, shardings))
+        dt = time.perf_counter() - t0
         self.telemetry.add(bytes_moved=total, transfer_calls=1,
-                           transfer_s=time.perf_counter() - t0,
+                           transfer_s=dt,
                            chunks_fed=len(arrays), groups=1,
                            coalesced_chunks=len(arrays))
+        self._obs_transfer(total, dt, len(arrays))
         outs = self._unpack_bytes(packed, tuple(layout), shardings)
         # the slot is rewritten only after these outputs exist on device
         slot.fence = outs
@@ -470,9 +497,10 @@ class DeviceFeed:
             sh = self._chunk_sharding(c.ndim)
             t0 = time.perf_counter()
             x = self._device_put(c, sh)
+            dt = time.perf_counter() - t0
             tel.add(bytes_moved=c.nbytes, transfer_calls=1,
-                    transfer_s=time.perf_counter() - t0,
-                    chunks_fed=1, groups=1)
+                    transfer_s=dt, chunks_fed=1, groups=1)
+            self._obs_transfer(c.nbytes, dt, 1)
             return x
 
         chunks = [c for c, _n in group]
@@ -500,9 +528,11 @@ class DeviceFeed:
                 slot.busy = False
                 self._degrade(f"packed stack transfer failed after retries: {e}")
                 return [put_one(c) for c in chunks], None
+            dt = time.perf_counter() - t0
             tel.add(bytes_moved=slot.buf.nbytes, transfer_calls=1,
-                    transfer_s=time.perf_counter() - t0,
-                    chunks_fed=k, groups=1, coalesced_chunks=k)
+                    transfer_s=dt, chunks_fed=k, groups=1,
+                    coalesced_chunks=k)
+            self._obs_transfer(slot.buf.nbytes, dt, k)
             xs = list(self._unpack_stack(packed, k, first.shape,
                                          first.dtype.str))
             return xs, slot
@@ -526,9 +556,10 @@ class DeviceFeed:
             slot.busy = False
             self._degrade(f"packed byte transfer failed after retries: {e}")
             return [put_one(c) for c in chunks], None
+        dt = time.perf_counter() - t0
         tel.add(bytes_moved=total, transfer_calls=1,
-                transfer_s=time.perf_counter() - t0,
-                chunks_fed=k, groups=1, coalesced_chunks=k)
+                transfer_s=dt, chunks_fed=k, groups=1, coalesced_chunks=k)
+        self._obs_transfer(total, dt, k)
         xs = list(self._unpack_bytes(packed, tuple(layout), None))
         return xs, slot
 

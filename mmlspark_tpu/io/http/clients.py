@@ -135,26 +135,40 @@ def _circuit_open_response(breaker: CircuitBreaker) -> HTTPResponseData:
 
 
 def send_request(req: HTTPRequestData, timeout: float = 60.0) -> HTTPResponseData:
-    """One HTTP exchange; transport errors become status 0 / reason text."""
+    """One HTTP exchange; transport errors become status 0 / reason text.
+
+    Trace propagation: when the calling thread is inside a span, the
+    current context rides out as `X-Trace-Id`/`X-Span-Id` (caller-set
+    headers win), the downstream server continues the trace, and the
+    exchange is recorded as an `http.send` child span here."""
+    headers = telemetry.trace_headers(req.headers)
+    ctx = telemetry.current_context()
     r = urllib.request.Request(
-        req.url, data=req.entity, headers=req.headers or {},
-        method=req.method,
+        req.url, data=req.entity, headers=headers, method=req.method,
     )
+    t0 = time.perf_counter()
     try:
         fault_point("http.send")
         with urllib.request.urlopen(r, timeout=timeout) as resp:
-            return HTTPResponseData(
+            out = HTTPResponseData(
                 status_code=resp.status, reason=resp.reason or "",
                 headers=dict(resp.headers.items()), entity=resp.read(),
             )
     except urllib.error.HTTPError as e:
-        return HTTPResponseData(
+        out = HTTPResponseData(
             status_code=e.code, reason=str(e.reason),
             headers=dict(e.headers.items()) if e.headers else {},
             entity=e.read(),
         )
     except Exception as e:  # URLError, timeout, connection refused...
-        return HTTPResponseData(status_code=0, reason=f"{type(e).__name__}: {e}")
+        out = HTTPResponseData(status_code=0,
+                               reason=f"{type(e).__name__}: {e}")
+    dt = time.perf_counter() - t0
+    telemetry.histogram("io.http.request.latency").observe(dt)
+    if ctx is not None:
+        telemetry.record_span("http.send", ctx, dt,
+                              url=req.url, status=out.status_code)
+    return out
 
 
 class HandlingUtils:

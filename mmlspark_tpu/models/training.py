@@ -7,6 +7,7 @@ handled by XLA from sharding annotations.  bfloat16 compute, float32 state.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -15,6 +16,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import telemetry as core_telemetry
 from ..parallel.mesh import batch_sharding, default_mesh, replicated_sharding
 
 __all__ = ["TrainState", "make_train_step", "make_train_epoch",
@@ -304,7 +306,19 @@ def fit_epochs(
             slices = ((bi[s : s + k], bl[s : s + k])
                       for s in range(0, steps, k))
             for dbi, dbl in feed.stream(slices, shardings=(img_sh, img_sh)):
+                t0 = time.perf_counter()
                 state, ms = epoch_fn(state, dbi, dbl)
+                # one scanned dispatch = len(dbi) optimizer steps; block
+                # on the metrics so the timing covers the device work,
+                # not just async dispatch
+                jax.block_until_ready(ms)
+                dt = time.perf_counter() - t0
+                k_real = max(1, int(dbi.shape[0]))
+                core_telemetry.histogram(
+                    "models.training.step_latency").observe(dt / k_real)
+                core_telemetry.gauge(
+                    "models.training.examples_per_sec").set(
+                        k_real * batch_size / dt if dt > 0 else 0.0)
             metrics = {k2: float(np.asarray(v)[-1]) for k2, v in ms.items()}
             if log_fn:
                 log_fn(int(state.step), metrics)
@@ -315,8 +329,16 @@ def fit_epochs(
         for dbi, dbl in feed.stream(
                 batches, shardings=(batch_sharding(mesh, 4),
                                     batch_sharding(mesh, 1))):
+            t0 = time.perf_counter()
             state, m = step_fn(state, dbi, dbl)
+            # the float() pulls block on the step's device work, so the
+            # measured wall is the true per-step cost, not dispatch
             metrics = {k: float(v) for k, v in m.items()}
+            dt = time.perf_counter() - t0
+            core_telemetry.histogram(
+                "models.training.step_latency").observe(dt)
+            core_telemetry.gauge("models.training.examples_per_sec").set(
+                batch_size / dt if dt > 0 else 0.0)
             if log_fn:
                 log_fn(int(state.step), metrics)
     return state, metrics
@@ -357,7 +379,6 @@ def fit_epochs_resumable(
     ("training.step")` each step so chaos tests can kill it mid-epoch.
     Telemetry: ``training.autosave`` per checkpoint written,
     ``training.resume`` when a run starts from a restored step."""
-    from ..core import telemetry as core_telemetry
     from ..io.feed import DeviceFeed
     from ..utils.faults import fault_point
     # lazy: checkpoint.py imports TrainState from this module
@@ -401,8 +422,14 @@ def fit_epochs_resumable(
             idx = order[b * batch_size:(b + 1) * batch_size]
             dbi, dbl = feed.put_group([images[idx], labels[idx]],
                                       shardings=(img_sh, lbl_sh))
+            t0 = time.perf_counter()
             state, m = step_fn(state, dbi, dbl)
             metrics = {k: float(v) for k, v in m.items()}
+            dt = time.perf_counter() - t0
+            core_telemetry.histogram(
+                "models.training.step_latency").observe(dt)
+            core_telemetry.gauge("models.training.examples_per_sec").set(
+                batch_size / dt if dt > 0 else 0.0)
             if log_fn:
                 log_fn(int(state.step), metrics)
             if int(state.step) % checkpoint_every == 0:

@@ -75,6 +75,10 @@ class _Request:
         self.deadline = deadline      # absolute monotonic admission budget
         self.stream = TokenStream()
         self.emitted = 0
+        # submitter's trace context: admission latency is attributed back
+        # to the submitting request's span (the loop is another thread)
+        self.trace = telemetry.current_context()
+        self.submitted_at = time.monotonic()
 
 
 class ContinuousBatcher:
@@ -470,6 +474,8 @@ class ContinuousBatcher:
                     raise ValueError(f"prefix {prefix} was released")
                 self._prefixes[prefix]["refs"] += 1
             self._pending.put(req)
+        telemetry.gauge("serving.batcher.queue_depth").set(
+            self._pending.qsize() + len(self._buffer))
         return req.stream
 
     def stream_text(self, tokenizer, text: str,
@@ -573,6 +579,12 @@ class ContinuousBatcher:
         loads drop (out-of-range sentinel + mode='drop')."""
         from ..models.generation import _prefill_cache
 
+        now = time.monotonic()
+        for slot, req in batch:
+            # slot-wait span on the SUBMITTER's trace (cross-thread hop)
+            if req.trace is not None:
+                telemetry.record_span("serving.batcher.admit", req.trace,
+                                      now - req.submitted_at, slot=slot)
         by_bucket: dict = {}
         prefix_groups: dict = {}
         for slot, req in batch:
@@ -763,6 +775,8 @@ class ContinuousBatcher:
             try:
                 self._buffer.append(self._pending.get_nowait())
             except Empty:
+                telemetry.gauge("serving.batcher.queue_depth").set(
+                    self._pending.qsize() + len(self._buffer))
                 return
 
     def _try_admit(self):
@@ -848,6 +862,8 @@ class ContinuousBatcher:
                 # nothing live -> every reservation is released, so the
                 # head always fits; the next iteration admits it
                 continue
+            telemetry.histogram("serving.batcher.batch_fill").observe(
+                len(active) / self.max_slots)
             if self.paged:
                 # grow each active slot's page list just-in-time for this
                 # tick's write positions — speculative mode writes up to

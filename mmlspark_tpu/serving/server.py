@@ -23,7 +23,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -74,6 +74,12 @@ class CachedRequest:
     # absolute time.monotonic() budget from the X-Deadline-Ms header; an
     # expired request is failed fast at batch admission, never computed
     deadline: Optional[float] = None
+    # trace context (trace_id, span_id) of the handler's serving.request
+    # span: the batch loop runs on ANOTHER thread, so propagation across
+    # that hop is explicit — the loop re-activates this via use_trace()
+    trace: Optional[Tuple[str, str]] = None
+    # when the request entered the queue (monotonic): queue-wait span
+    accepted_at: Optional[float] = None
 
 
 class WorkerServer:
@@ -136,12 +142,32 @@ class WorkerServer:
                 if self.path.rstrip("/") != outer.path.rstrip("/"):
                     self.send_error(404)
                     return
+                # continue the caller's trace (X-Trace-Id / X-Span-Id) or
+                # root a fresh one; the whole held exchange is one span
+                ctx = telemetry.extract_trace(self.headers)
+                t0 = time.perf_counter()
+                outcome = "error"
+                try:
+                    with telemetry.span("serving.request", parent_ctx=ctx,
+                                        endpoint=outer.path) as sp:
+                        outcome = self._handle_post(sp)
+                        sp.attrs["outcome"] = outcome
+                finally:
+                    telemetry.histogram(
+                        "serving.request.latency",
+                        endpoint=outer.path, outcome=outcome,
+                    ).observe(time.perf_counter() - t0)
+
+            def _handle_post(self, sp) -> str:
+                """The held-exchange body; returns the outcome label for
+                the serving.request.latency histogram ("ok" / "shed" /
+                "timeout" / "error")."""
                 # keep-alive framing safety: an unread chunked body would be
                 # parsed as the NEXT request on this held connection
                 if "chunked" in self.headers.get(
                         "Transfer-Encoding", "").lower():
                     self.send_error(501, "chunked transfer not supported")
-                    return
+                    return "error"
                 length = int(self.headers.get("Content-Length", 0))
                 # read the body BEFORE any early reply: unread bytes would
                 # frame as the next request on this keep-alive connection
@@ -157,7 +183,7 @@ class WorkerServer:
                         503, b'{"error": "server overloaded, retry later"}',
                         {"Retry-After": "1",
                          "Content-Type": "application/json"})
-                    return
+                    return "shed"
                 deadline = None
                 dl_ms = self.headers.get("X-Deadline-Ms")
                 if dl_ms is not None:
@@ -172,6 +198,8 @@ class WorkerServer:
                         headers=dict(self.headers.items()), entity=body,
                     ),
                     deadline=deadline,
+                    trace=(sp.trace_id, sp.span_id),
+                    accepted_at=time.monotonic(),
                 )
                 if outer.journal is not None:
                     outer.journal.log_request(req.id, body,
@@ -183,10 +211,10 @@ class WorkerServer:
                     if not req.done.wait(outer.handler_timeout):
                         outer._finish(req.id)
                         self.send_error(504, "model timed out")
-                        return
+                        return "timeout"
                     if req.stream is not None:
                         self._drain_stream(req)
-                        return
+                        return "ok"
                 finally:
                     # all exits (reply sent, 504, disconnect) tell the
                     # producer this exchange is over — StreamWriter.write
@@ -200,6 +228,45 @@ class WorkerServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                sc = resp.status_code
+                if sc < 400:
+                    return "ok"
+                if sc == 503:
+                    return "shed"
+                if sc == 504:
+                    return "timeout"
+                return "error"
+
+            def do_GET(self):
+                """Observability endpoints on every worker server:
+                `/metrics` (Prometheus text exposition of the process
+                registry) and `/trace/<id>` (one trace's spans + nested
+                tree as JSON)."""
+                path = self.path.split("?", 1)[0]
+                if path.rstrip("/") == "/metrics":
+                    payload = telemetry.render_prometheus().encode("utf-8")
+                    self._reply_bytes(
+                        200, payload,
+                        {"Content-Type":
+                         "text/plain; version=0.0.4; charset=utf-8"})
+                    return
+                if path.startswith("/trace/"):
+                    tid = path[len("/trace/"):].strip("/")
+                    spans = telemetry.get_trace(tid)
+                    if not spans:
+                        self._reply_bytes(
+                            404, b'{"error": "unknown trace id"}',
+                            {"Content-Type": "application/json"})
+                        return
+                    payload = json.dumps({
+                        "trace_id": tid,
+                        "spans": spans,
+                        "tree": telemetry.span_tree(tid),
+                    }).encode("utf-8")
+                    self._reply_bytes(200, payload,
+                                      {"Content-Type": "application/json"})
+                    return
+                self.send_error(404)
 
             def _reply_bytes(self, status: int, body: bytes,
                              headers: Dict[str, str]):
@@ -588,63 +655,84 @@ class ServingServer:
             else:
                 epoch, batch = self.server.get_epoch_batch(
                     self.max_batch, self.batch_timeout_ms)
+            telemetry.gauge("serving.queue.depth").set(
+                self.server.queue.qsize())
             if not batch:
                 self.server.commit(epoch)  # empty epochs GC immediately
                 continue
-            # chaos hook: an InjectedCrash here escapes except Exception
-            # below and kills the consumer thread mid-batch — exactly the
-            # death the supervisor + epoch replay must absorb (the batch
-            # is already recorded in the epoch history)
-            fault_point("serving.batch_loop")
-            if self.stream_fn is not None:
-                # rows come straight from each request's JSON body: the
-                # columnar parse would coerce types batch-dependently (a
-                # lone list becomes an ndarray slice; co-batched ragged
-                # lists stay lists) — stream_fn must see stable types
-                for req in batch:
-                    if req.recovered:
-                        # a journal-replayed stream has NO client socket:
-                        # generating into it would be pure waste.  Streams
-                        # are at-most-once; mark replied and move on.
-                        self.server.reply_to(req.id, HTTPResponseData(
-                            410, "client gone across restart"))
-                        continue
-                    try:
-                        row = json.loads(req.request.entity or b"{}")
-                    except json.JSONDecodeError:
-                        row = {}
-                    if self.input_schema is not None:
-                        row = {k: row.get(k) for k in self.input_schema}
-                    self._stream_pool.submit(self._stream_one, req.id, row)
-                self.stats["requests"] += len(batch)
-                self.stats["batches"] += 1
-                self.server.commit(epoch)  # at-most-once past this point
-                continue
-            try:
-                table, id_col = parse_request(batch, self.input_schema)
-                out = self.model.transform(table)
-                make_reply(out, self.reply_col, self.server, id_col=id_col)
-                self.stats["requests"] += len(batch)
-                self.stats["batches"] += 1
-                self.server.commit(epoch)
-            except Exception as e:  # noqa: BLE001 — serving must survive
-                self.stats["errors"] += 1
-                for req in batch:
-                    if req.done.is_set():
-                        continue  # make_reply answered it before failing
-                    if req.attempts + 1 < self.max_attempts:
-                        self.server.requeue(req)
-                    else:
-                        self.server.reply_to(
-                            req.id,
-                            HTTPResponseData(
-                                500, "model error", {},
-                                json.dumps({"error": str(e)}).encode(),
-                            ),
-                        )
-                self.server.commit(epoch)  # requeued/answered: history done
+            telemetry.histogram("serving.batch.fill").observe(
+                len(batch) / max(1, self.max_batch))
+            now = time.monotonic()
+            for req in batch:
+                # attribute each request's queue wait back onto ITS trace:
+                # the handler thread's serving.request span is the parent
+                if req.trace is not None and req.accepted_at is not None:
+                    telemetry.record_span("serving.batcher.queue",
+                                          req.trace, now - req.accepted_at)
+            # the batch span continues the first traced request's context
+            # across the thread hop (a batch serves many traces; the rest
+            # keep their queue-wait spans above)
+            batch_ctx = next((r.trace for r in batch if r.trace), None)
+            with telemetry.use_trace(batch_ctx), \
+                    telemetry.span("serving.batcher.batch",
+                                   batch_size=len(batch), epoch=epoch):
+                # chaos hook: an InjectedCrash here escapes except Exception
+                # below and kills the consumer thread mid-batch — exactly the
+                # death the supervisor + epoch replay must absorb (the batch
+                # is already recorded in the epoch history)
+                fault_point("serving.batch_loop")
+                if self.stream_fn is not None:
+                    # rows come straight from each request's JSON body: the
+                    # columnar parse would coerce types batch-dependently (a
+                    # lone list becomes an ndarray slice; co-batched ragged
+                    # lists stay lists) — stream_fn must see stable types
+                    for req in batch:
+                        if req.recovered:
+                            # a journal-replayed stream has NO client socket:
+                            # generating into it would be pure waste.  Streams
+                            # are at-most-once; mark replied and move on.
+                            self.server.reply_to(req.id, HTTPResponseData(
+                                410, "client gone across restart"))
+                            continue
+                        try:
+                            row = json.loads(req.request.entity or b"{}")
+                        except json.JSONDecodeError:
+                            row = {}
+                        if self.input_schema is not None:
+                            row = {k: row.get(k) for k in self.input_schema}
+                        self._stream_pool.submit(self._stream_one, req.id,
+                                                 row, req.trace)
+                    self.stats["requests"] += len(batch)
+                    self.stats["batches"] += 1
+                    self.server.commit(epoch)  # at-most-once past this point
+                    continue
+                try:
+                    table, id_col = parse_request(batch, self.input_schema)
+                    out = self.model.transform(table)
+                    make_reply(out, self.reply_col, self.server,
+                               id_col=id_col)
+                    self.stats["requests"] += len(batch)
+                    self.stats["batches"] += 1
+                    self.server.commit(epoch)
+                except Exception as e:  # noqa: BLE001 — serving must survive
+                    self.stats["errors"] += 1
+                    for req in batch:
+                        if req.done.is_set():
+                            continue  # make_reply answered it before failing
+                        if req.attempts + 1 < self.max_attempts:
+                            self.server.requeue(req)
+                        else:
+                            self.server.reply_to(
+                                req.id,
+                                HTTPResponseData(
+                                    500, "model error", {},
+                                    json.dumps({"error": str(e)}).encode(),
+                                ),
+                            )
+                    self.server.commit(epoch)  # history done
 
-    def _stream_one(self, request_id: str, row: Dict[str, Any]):
+    def _stream_one(self, request_id: str, row: Dict[str, Any],
+                    trace: Optional[Tuple[str, str]] = None):
         """Produce one request's chunk stream on the pool.
 
         The chunked exchange opens only once the FIRST chunk exists: a
@@ -652,6 +740,10 @@ class ServingServer:
         HTTP 500 (the status line isn't spent yet).  An error after the
         first chunk can only be reported in-band; BrokenPipeError means
         the client left — stop generating."""
+        with telemetry.use_trace(trace):
+            self._stream_one_traced(request_id, row)
+
+    def _stream_one_traced(self, request_id: str, row: Dict[str, Any]):
         def enc(c):
             return c.encode("utf-8") if isinstance(c, str) else c
 

@@ -1,7 +1,16 @@
 """Nanosecond stopwatch (reference: core/utils/StopWatch.scala:6 — the
-ns-resolution timer behind VW's TrainingStats phase diagnostics)."""
+ns-resolution timer behind VW's TrainingStats phase diagnostics).
+
+This is the ONE StopWatch in the tree: `core.telemetry.StopWatch` is a
+re-export of this class (the two copies that used to live in both places
+drifted — a shared identity is pinned by tests/test_observability.py).
+It merges both historical surfaces: `with sw:` / `sw.measure(fn)` from
+this module, plus `with sw.measure():` and `elapsed_sec` from the old
+core.telemetry copy.
+"""
 from __future__ import annotations
 
+import contextlib
 import time
 
 __all__ = ["StopWatch"]
@@ -34,16 +43,36 @@ class StopWatch:
         )
         return (self.elapsed_ns + running) / 1e9
 
+    # the old core.telemetry.StopWatch spelling
+    elapsed_sec = elapsed_s
+
     def __enter__(self) -> "StopWatch":
         return self.start()
 
     def __exit__(self, *exc) -> None:
         self.stop()
 
-    def measure(self, fn, *args, **kwargs):
-        """Time one call; returns (result, elapsed_ns of the call)."""
+    def measure(self, fn=None, *args, **kwargs):
+        """Two historical shapes behind one name:
+
+        * ``measure(fn, *args)`` times one call, returns
+          ``(result, elapsed_ns of the call)``;
+        * ``measure()`` (no fn) returns a context manager that
+          accumulates the block's wall time (the old
+          core.telemetry.StopWatch.measure).
+        """
+        if fn is None:
+            return self._measure_block()
         t0 = time.perf_counter_ns()
         out = fn(*args, **kwargs)
         dt = time.perf_counter_ns() - t0
         self.elapsed_ns += dt
         return out, dt
+
+    @contextlib.contextmanager
+    def _measure_block(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()

@@ -1,0 +1,454 @@
+"""Observability layer suite: spans, histograms, /metrics exposition,
+end-to-end trace propagation, and the satellite fixes (StopWatch dedupe,
+records locking/maxlen, metrics-name lint).  See docs/observability.md.
+"""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import telemetry
+from mmlspark_tpu.core.telemetry.metrics import (
+    BYTE_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+    default_buckets,
+)
+from mmlspark_tpu.core.telemetry.records import RECORDS_MAXLEN
+
+
+# ------------------------------------------------------ satellite: stopwatch
+def test_stopwatch_is_one_class():
+    """The two historical StopWatch implementations are ONE class now,
+    re-exported from both import paths."""
+    import mmlspark_tpu.core.telemetry as core_tel
+    from mmlspark_tpu.utils.stopwatch import StopWatch as utils_sw
+
+    assert core_tel.StopWatch is utils_sw
+    assert telemetry.StopWatch is utils_sw
+
+
+def test_stopwatch_surface():
+    sw = telemetry.StopWatch()
+    sw.start()
+    sw.stop()
+    assert sw.elapsed_ns >= 0
+    assert sw.elapsed_s == sw.elapsed_sec  # both spellings, same number
+    with telemetry.StopWatch() as sw2:
+        pass
+    assert sw2.elapsed_ns >= 0
+    out, dt = telemetry.StopWatch().measure(lambda x: x + 1, 41)
+    assert out == 42 and dt >= 0
+
+
+# ------------------------------------------------- satellite: verb records
+def test_records_bounded_by_maxlen():
+    telemetry.clear_records()
+    try:
+        for _ in range(RECORDS_MAXLEN + 64):
+            with telemetry.log_verb(object(), "transform"):
+                pass
+        recs = telemetry.recent_records()
+        assert len(recs) == RECORDS_MAXLEN  # ring, not unbounded growth
+        assert recs[-1]["method"] == "transform"
+        assert "wallTimeSec" in recs[-1]
+    finally:
+        telemetry.clear_records()
+    assert telemetry.recent_records() == []
+
+
+def test_records_concurrent_read_write_no_mutation_error():
+    """recent_records() snapshots under the lock: concurrent log_verb
+    appends must never raise 'deque mutated during iteration'."""
+    telemetry.clear_records()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        while not stop.is_set():
+            with telemetry.log_verb(object(), "fit"):
+                pass
+
+    def reader():
+        try:
+            for _ in range(300):
+                telemetry.recent_records()
+                telemetry.clear_records()
+        except Exception as e:  # noqa: BLE001 — the failure under test
+            errors.append(e)
+
+    ws = [threading.Thread(target=writer) for _ in range(3)]
+    rs = [threading.Thread(target=reader) for _ in range(2)]
+    for t in ws + rs:
+        t.start()
+    for t in rs:
+        t.join(timeout=30)
+    stop.set()
+    for t in ws:
+        t.join(timeout=30)
+    telemetry.clear_records()
+    assert not errors, errors
+
+
+# --------------------------------------------------------- histogram buckets
+def test_histogram_edge_lands_in_its_bucket():
+    """Prometheus `le` semantics: v == boundary counts into THAT bucket."""
+    h = Histogram("t.edge", boundaries=(1.0, 2.0, 4.0))
+    h.observe(2.0)
+    snap = h.snapshot()
+    # cumulative: le=1.0 -> 0, le=2.0 -> 1, le=4.0 -> 1, +Inf -> 1
+    assert snap["buckets"] == [(1.0, 0), (2.0, 1), (4.0, 1),
+                               (float("inf"), 1)]
+
+
+def test_histogram_overflow_goes_to_inf_bucket():
+    h = Histogram("t.inf", boundaries=(1.0, 2.0))
+    h.observe(100.0)
+    snap = h.snapshot()
+    assert snap["buckets"][-1] == (float("inf"), 1)
+    assert snap["buckets"][0] == (1.0, 0) and snap["buckets"][1] == (2.0, 0)
+    # a quantile cannot resolve beyond its ladder: report the last edge
+    assert h.percentile(0.5) == 2.0
+
+
+def test_histogram_rejects_unsorted_boundaries():
+    with pytest.raises(ValueError):
+        Histogram("t.bad", boundaries=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("t.dup", boundaries=(1.0, 1.0, 2.0))
+
+
+def test_histogram_striped_observe_merges_exactly():
+    h = Histogram("t.striped", boundaries=(0.5, 1.0, 2.0))
+    n_threads, per_thread = 8, 500
+
+    def work():
+        for i in range(per_thread):
+            h.observe(0.25 if i % 2 else 0.75)
+
+    ts = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = h.snapshot()
+    assert snap["count"] == n_threads * per_thread  # nothing lost to races
+    assert snap["buckets"][-1][1] == snap["count"]  # +Inf cum == total
+    p50 = h.percentile(0.5)
+    assert 0.0 < p50 <= 1.0
+
+
+def test_histogram_empty_percentiles_are_none():
+    h = Histogram("t.empty")
+    assert h.percentile(0.5) is None
+    assert h.snapshot()["p99"] is None
+
+
+def test_default_ladders():
+    bs = default_buckets()
+    assert len(bs) == 19
+    assert bs[0] == pytest.approx(1e-6) and bs[-1] == pytest.approx(1e3)
+    assert list(bs) == sorted(bs)
+    assert BYTE_BUCKETS[0] == 64.0 and BYTE_BUCKETS[-1] >= 2 ** 30
+    # first-touch fixes the family ladder: labeled children share it
+    reg = MetricsRegistry()
+    a = reg.histogram("fam.x", boundaries=(1.0, 2.0), kind="a")
+    b = reg.histogram("fam.x", kind="b")
+    assert a.boundaries == b.boundaries == (1.0, 2.0)
+
+
+# ------------------------------------------------------------------- registry
+def test_registry_counter_semantics_preserved():
+    reg = MetricsRegistry()
+    reg.incr("x.a")
+    reg.incr("x.a")
+    reg.incr("y.b", 3)
+    assert reg.counter_values() == {"x.a": 2, "y.b": 3}
+    assert reg.counter_values("x.") == {"x.a": 2}
+    reg.reset_counters("x.")
+    assert reg.counter_values() == {"y.b": 3}
+    reg.reset_counters()
+    assert reg.counter_values() == {}
+
+
+def test_prometheus_exposition_text():
+    reg = MetricsRegistry()
+    reg.incr("serving.shed", 2)
+    reg.gauge("serving.queue.depth").set(5)
+    reg.histogram("serving.request.latency",
+                  endpoint="/p", outcome="ok").observe(0.01)
+    text = telemetry.render_prometheus(reg)
+    assert "# TYPE serving_shed counter\nserving_shed 2" in text
+    assert "# TYPE serving_queue_depth gauge\nserving_queue_depth 5" in text
+    assert "# TYPE serving_request_latency histogram" in text
+    assert 'le="+Inf"' in text
+    assert 'serving_request_latency_bucket{endpoint="/p",outcome="ok",' \
+        in text
+    assert "serving_request_latency_sum" in text
+    assert "serving_request_latency_count" in text
+
+
+def test_export_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.incr("faults.injected")
+    reg.gauge("io.feed.overlap_frac").set(0.5)
+    reg.histogram("io.feed.transfer.latency").observe(0.001)
+    snap = telemetry.export_snapshot(reg, include_spans=False)
+    assert snap["counters"] == {"faults.injected": 1}
+    assert snap["gauges"] == {"io.feed.overlap_frac": 0.5}
+    h = snap["histograms"]["io.feed.transfer.latency"]
+    assert h["count"] == 1 and h["buckets"][-1][0] == "+Inf"
+    json.dumps(snap)  # JSON-serializable end to end
+    assert "spans" not in snap
+    assert "spans" in telemetry.export_snapshot(reg)
+
+
+# ---------------------------------------------------------------------- spans
+def test_span_nesting_and_trace_linkage():
+    telemetry.clear_spans()
+    with telemetry.span("outer", layer="test") as outer:
+        with telemetry.span("inner") as inner:
+            assert telemetry.current_context() == (inner.trace_id,
+                                                   inner.span_id)
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+    assert telemetry.current_context() is None
+    recs = telemetry.get_trace(outer.trace_id)
+    assert [r["name"] for r in recs] == ["inner", "outer"]  # completion order
+    tree = telemetry.span_tree(outer.trace_id)
+    assert len(tree) == 1 and tree[0]["name"] == "outer"
+    assert tree[0]["attrs"] == {"layer": "test"}
+    assert [c["name"] for c in tree[0]["children"]] == ["inner"]
+
+
+def test_span_records_exception_and_reraises():
+    telemetry.clear_spans()
+    with pytest.raises(ValueError):
+        with telemetry.span("boom") as sp:
+            raise ValueError("x")
+    rec = telemetry.get_trace(sp.trace_id)[0]
+    assert rec["error"] == "ValueError"
+    assert telemetry.current_context() is None  # context restored
+
+
+def test_use_trace_and_record_span_cross_thread():
+    telemetry.clear_spans()
+    with telemetry.span("parent") as sp:
+        ctx = (sp.trace_id, sp.span_id)
+    seen = {}
+
+    def worker():
+        with telemetry.use_trace(ctx):
+            seen["ctx"] = telemetry.current_context()
+            with telemetry.span("child.on.thread"):
+                pass
+        telemetry.record_span("queue.wait", ctx, 0.005, slot=3)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["ctx"] == ctx
+    names = {r["name"] for r in telemetry.get_trace(sp.trace_id)}
+    assert names == {"parent", "child.on.thread", "queue.wait"}
+    tree = telemetry.span_tree(sp.trace_id)
+    assert {c["name"] for c in tree[0]["children"]} == \
+        {"child.on.thread", "queue.wait"}
+    qw = [c for c in tree[0]["children"] if c["name"] == "queue.wait"][0]
+    assert qw["wall_s"] == 0.005 and qw["attrs"] == {"slot": 3}
+    # use_trace(None) is a no-op so call sites pass maybe-absent contexts
+    with telemetry.use_trace(None):
+        assert telemetry.current_context() is None
+
+
+def test_trace_header_inject_and_extract():
+    with telemetry.span("client.op") as sp:
+        h = telemetry.trace_headers({"Accept": "application/json"})
+        assert h["X-Trace-Id"] == sp.trace_id
+        assert h["X-Span-Id"] == sp.span_id
+        assert h["Accept"] == "application/json"
+        # caller-set headers win (setdefault, not overwrite)
+        h2 = telemetry.trace_headers({"X-Trace-Id": "caller"})
+        assert h2["X-Trace-Id"] == "caller"
+    assert "X-Trace-Id" not in telemetry.trace_headers({})  # outside a span
+    assert telemetry.extract_trace({"x-trace-id": "t1", "X-SPAN-ID": "s1"}) \
+        == ("t1", "s1")
+    assert telemetry.extract_trace({"X-Trace-Id": "t2"}) == ("t2", "")
+    assert telemetry.extract_trace({"Content-Type": "text/plain"}) is None
+
+
+def test_span_store_is_bounded():
+    from mmlspark_tpu.core.telemetry import spans as spans_mod
+
+    telemetry.clear_spans()
+    try:
+        for i in range(spans_mod.MAX_TRACES + 10):
+            telemetry.record_span("s", (f"trace{i:05d}", "p"), 0.001)
+        assert len(telemetry.recent_spans()) <= spans_mod.MAX_SPANS
+        assert telemetry.get_trace("trace00000") == []  # oldest evicted
+        assert len(telemetry.get_trace(
+            f"trace{spans_mod.MAX_TRACES + 9:05d}")) == 1
+    finally:
+        telemetry.clear_spans()
+
+
+# -------------------------------------------- end-to-end trace propagation
+def _traced_model():
+    """Model whose compute crosses DeviceFeed.put, so the feed.transfer
+    span must appear under the request's trace."""
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+    from mmlspark_tpu.io.feed import DeviceFeed
+
+    feed = DeviceFeed()
+
+    def fn(table):
+        v = np.asarray(table["v"], np.float32)
+        dv = feed.put(v)
+        return table.with_column("y", np.asarray(dv) * 2.0)
+
+    return LambdaTransformer(fn)
+
+
+def test_serving_roundtrip_trace_and_metrics_endpoints():
+    """The acceptance path: one traced request produces a server ->
+    batcher -> feed span tree under the CLIENT'S trace id, visible via
+    /trace/<id>, and /metrics exposes the serving histogram buckets."""
+    from mmlspark_tpu.io.http.clients import send_request
+    from mmlspark_tpu.io.http.schema import to_http_request
+    from mmlspark_tpu.serving.server import ServingServer
+
+    telemetry.clear_spans()
+    tid = "obs1234trace5678"
+    srv = ServingServer(_traced_model(), reply_col="y", name="obs-e2e",
+                        path="/obs", input_schema=["v"],
+                        batch_timeout_ms=5.0)
+    info = srv.start()
+    try:
+        resp = send_request(to_http_request(
+            info.url, {"v": 21.0}, headers={"X-Trace-Id": tid}), timeout=30)
+        assert resp.status_code == 200, (resp.status_code, resp.reason)
+        assert resp.json() == {"y": 42.0}
+
+        # the in-process span store links all three layers under OUR id
+        names = {s["name"] for s in telemetry.get_trace(tid)}
+        assert "serving.request" in names
+        assert "serving.batcher.queue" in names
+        assert "serving.batcher.batch" in names
+        assert "feed.transfer" in names, names
+
+        base = f"http://{info.host}:{info.port}"
+        with urllib.request.urlopen(f"{base}/trace/{tid}", timeout=10) as r:
+            assert r.status == 200
+            doc = json.loads(r.read())
+        assert doc["trace_id"] == tid
+        got = {s["name"] for s in doc["spans"]}
+        assert {"serving.request", "serving.batcher.batch",
+                "feed.transfer"} <= got
+        # nested tree: serving.request roots (its parent span lives in
+        # THIS client process, not the server's store)
+        roots = {n["name"] for n in doc["tree"]}
+        assert "serving.request" in roots
+
+        with urllib.request.urlopen(f"{base}/trace/nosuchtrace",
+                                    timeout=10) as r:
+            pytest.fail(f"unknown trace returned {r.status}")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        assert json.loads(e.read())["error"] == "unknown trace id"
+    finally:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{info.host}:{info.port}/metrics",
+                    timeout=10) as r:
+                ctype = r.headers["Content-Type"]
+                body = r.read().decode()
+            assert r.status == 200 and "text/plain" in ctype
+            assert "serving_request_latency_bucket" in body
+            assert 'le="+Inf"' in body
+            assert "serving_queue_depth" in body
+            assert "serving_batch_fill" in body
+            assert "io_feed_transfer_latency" in body
+            assert "io_feed_transfer_bytes_bucket" in body
+        finally:
+            srv.stop()
+
+
+def test_client_injects_trace_headers_on_the_wire():
+    """send_request inside a span stamps X-Trace-Id/X-Span-Id onto the
+    actual HTTP request (not just a local dict)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mmlspark_tpu.io.http.clients import send_request
+    from mmlspark_tpu.io.http.schema import to_http_request
+
+    class _HeaderEcho(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            out = json.dumps(dict(self.headers.items())).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _HeaderEcho)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = "http://%s:%s/" % httpd.server_address[:2]
+    try:
+        with telemetry.span("client.call") as sp:
+            resp = send_request(to_http_request(url, {"q": 1}), timeout=10)
+        echoed = {k.lower(): v for k, v in resp.json().items()}
+        assert echoed["x-trace-id"] == sp.trace_id
+        assert echoed["x-span-id"] == sp.span_id
+        # the exchange itself was recorded as an http.send child span
+        names = {s["name"] for s in telemetry.get_trace(sp.trace_id)}
+        assert "http.send" in names
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------------- satellite: metrics lint
+def test_metrics_lint_passes_on_tree(capsys):
+    from tools import ci
+
+    assert ci.metrics_lint() == 0
+    assert "all instrumented names declared" in capsys.readouterr().out
+
+
+def test_metrics_lint_catches_undeclared_name(tmp_path, monkeypatch,
+                                              capsys):
+    from tools import ci
+
+    bad = tmp_path / "rogue.py"
+    # built by concatenation so THIS file's source never matches the
+    # lint regex itself (tests/ is inside the scanned tree)
+    bad.write_text('telemetry.' + 'incr("totally.undeclared.name")\n'
+                   'telemetry.' + 'gauge("serving.queue.depth").set(1)\n')
+    monkeypatch.setattr(ci, "_py_files", lambda: [str(bad)])
+    assert ci.metrics_lint() == 1
+    out = capsys.readouterr().out
+    assert "totally.undeclared.name" in out and "M001" in out
+
+
+def test_metrics_lint_allows_dynamic_family_suffixes(tmp_path,
+                                                     monkeypatch):
+    from tools import ci
+
+    ok = tmp_path / "fine.py"
+    ok.write_text(
+        'telemetry.' + 'incr("faults.injected.feed.device_put")\n'
+        'telemetry.' + 'incr(f"circuit.open.{name}")\n')
+    monkeypatch.setattr(ci, "_py_files", lambda: [str(ok)])
+    assert ci.metrics_lint() == 0
+
+
+def test_declared_names_parse_matches_import():
+    from tools import ci
+    from mmlspark_tpu.core.telemetry.metrics import DECLARED_METRICS
+
+    assert ci._declared_metric_names() == set(DECLARED_METRICS)

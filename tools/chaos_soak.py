@@ -167,6 +167,24 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
     assert fires.get("feed.device_put", 0) > 0, \
         "no transfer faults fired — the soak proved nothing"
 
+    # ---- registry snapshot assertions ----------------------------------
+    # the fault counters flow through the metrics registry now: assert on
+    # the exported snapshot, not a raw counters() dict, so the soak also
+    # proves the one-registry wiring (incr -> snapshot -> /metrics)
+    snapshot = telemetry.export_snapshot()
+    snap_counters = snapshot["counters"]
+    assert snap_counters.get("faults.injected", 0) == sum(fires.values()), \
+        (f"registry faults.injected {snap_counters.get('faults.injected')} "
+         f"!= fault-injector fires {sum(fires.values())}")
+    assert snap_counters.get("serving.shed", 0) == len(shed), \
+        (f"registry serving.shed {snap_counters.get('serving.shed')} != "
+         f"observed 503s {len(shed)}")
+    assert snap_counters.get("serving.deadline_expired", 0) >= n_expired, \
+        "deadline expiries missing from the registry snapshot"
+    assert any(k.startswith("serving.request.latency")
+               for k in snapshot["histograms"]), \
+        "serving.request.latency histogram missing from the snapshot"
+
     return {
         "seed": seed,
         "requests": n_requests + n_expired,
@@ -179,8 +197,24 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
         "faults_fired": fires,
         "recoveries": srv.stats["recoveries"],
         "replayed": srv.stats["replayed"],
-        "counters": telemetry.counters(),
+        "counters": snap_counters,
+        "gauges": snapshot["gauges"],
+        "latency_p95_s": {
+            k: v["p95"] for k, v in snapshot["histograms"].items()
+            if k.startswith("serving.request.latency")},
     }
+
+
+def write_obs_snapshot(path) -> str:
+    """Dump the full observability snapshot (counters, gauges, histogram
+    buckets, AND the recent-span ring) to `path` — the input format
+    tools/obs_report.py renders."""
+    from mmlspark_tpu.core import telemetry
+
+    p = Path(path)
+    p.write_text(json.dumps(telemetry.export_snapshot(), indent=2,
+                            sort_keys=True))
+    return str(p)
 
 
 def main(argv=None):
@@ -190,9 +224,14 @@ def main(argv=None):
     ap.add_argument("--max-queue", type=int, default=8)
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON object")
+    ap.add_argument("--obs-out", metavar="PATH", default=None,
+                    help="write the full observability snapshot (spans "
+                         "included) to PATH for tools/obs_report.py")
     args = ap.parse_args(argv)
     summary = run_soak(seed=args.seed, n_requests=args.requests,
                        max_queue=args.max_queue)
+    if args.obs_out:
+        write_obs_snapshot(args.obs_out)
     if args.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
     else:

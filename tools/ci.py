@@ -5,7 +5,8 @@ Reference: the pipeline's style gate and sharded test matrix
 20-minute budgets and flaky-retry).  One command runs the same thing
 anywhere:
 
-    python tools/ci.py lint                 # style/correctness gate
+    python tools/ci.py lint                 # style gate + metrics lint
+    python tools/ci.py metrics-lint         # declared-metric-name check only
     python tools/ci.py test [--shards N] [--shard K] [--retries R]
     python tools/ci.py all                  # lint + every shard
 
@@ -24,6 +25,7 @@ import argparse
 import ast
 import glob
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -107,7 +109,86 @@ class _Lint(ast.NodeVisitor):
         return sorted(self.problems)
 
 
+# -------------------------------------------------------- metrics lint
+
+# where instrumented names live: incr/gauge/histogram calls on the
+# telemetry (or core_telemetry) module.  The literal (or an f-string's
+# literal prefix) must resolve against the registry's DECLARED_METRICS
+# table, so a typo'd name cannot record into a parallel series nobody
+# scrapes.
+_METRIC_CALL = re.compile(
+    r"(?:telemetry|core_telemetry)\s*\.\s*(?:incr|gauge|histogram)\s*\(\s*"
+    r"(f?)(\"|')([^\"'\n]+)\2")
+
+
+def _declared_metric_names():
+    """DECLARED_METRICS keys parsed out of metrics.py's dict literal via
+    AST — importing mmlspark_tpu here would pull jax into every lint."""
+    path = os.path.join(ROOT, "mmlspark_tpu", "core", "telemetry",
+                        "metrics.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # DECLARED_METRICS: Dict = {}
+            targets = [node.target]
+        else:
+            continue
+        if (any(isinstance(t, ast.Name) and t.id == "DECLARED_METRICS"
+                for t in targets)
+                and isinstance(node.value, ast.Dict)):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)}
+    raise RuntimeError(f"DECLARED_METRICS dict literal not found in {path}")
+
+
+def metrics_lint() -> int:
+    """Grep instrumented metric/counter names across the tree and fail
+    on any absent from DECLARED_METRICS (exact, or as a declared prefix
+    for dynamic families like `circuit.open.<host>`; an f-string's
+    dynamic tail is checked by its literal prefix)."""
+    declared = _declared_metric_names()
+
+    def resolves(name: str, dynamic_tail: bool) -> bool:
+        if name in declared:
+            return True
+        if any(name.startswith(d + ".") for d in declared):
+            return True
+        # an f-string prefix like "circuit.open." must itself sit on a
+        # declared family boundary
+        return dynamic_tail and name.rstrip(".") in declared
+
+    telemetry_pkg = os.path.join("mmlspark_tpu", "core", "telemetry")
+    failures = 0
+    for path in _py_files():
+        if telemetry_pkg in path:
+            continue  # the registry's own sources/docstrings
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for m in _METRIC_CALL.finditer(src):
+            is_f, literal = m.group(1) == "f", m.group(3)
+            name = literal.split("{", 1)[0] if is_f else literal
+            if not resolves(name, dynamic_tail=is_f and "{" in literal):
+                lineno = src[:m.start()].count("\n") + 1
+                print(f"{os.path.relpath(path, ROOT)}:{lineno}: M001 "
+                      f"metric {name!r} not in DECLARED_METRICS "
+                      f"(mmlspark_tpu/core/telemetry/metrics.py)")
+                failures += 1
+    if failures:
+        print(f"metrics-lint: {failures} undeclared metric name(s)")
+    else:
+        print("metrics-lint: all instrumented names declared")
+    return 1 if failures else 0
+
+
 def lint() -> int:
+    style_rc = _style_lint()
+    metrics_rc = metrics_lint()
+    return style_rc or metrics_rc
+
+
+def _style_lint() -> int:
     if shutil.which("ruff"):
         return subprocess.call(["ruff", "check", ROOT])
     failures = 0
@@ -170,7 +251,8 @@ def test(n_shards: int, shard: int, retries: int, timeout_s: int) -> int:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("command", choices=["lint", "test", "all"])
+    ap.add_argument("command", choices=["lint", "metrics-lint", "test",
+                                        "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
@@ -180,6 +262,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.command == "lint":
         return lint()
+    if args.command == "metrics-lint":
+        return metrics_lint()
     if args.command == "test":
         return test(args.shards, args.shard, args.retries, args.timeout)
     rc = lint()

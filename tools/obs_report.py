@@ -1,0 +1,145 @@
+"""obs_report: render a recorded observability snapshot for humans.
+
+Input: the JSON written by `telemetry.export_snapshot()` — e.g.
+`python tools/chaos_soak.py --obs-out /tmp/soak_obs.json`, or any code
+that dumps the snapshot after a run.  Output: per-trace span trees
+(server -> batcher -> feed, with wall times and errors) and a
+p50/p95/p99 latency table for every histogram in the registry.
+
+Usage:
+    python tools/obs_report.py SNAPSHOT.json [--trace TRACE_ID] [--top N]
+    python tools/obs_report.py --demo   # tiny in-process serving round-trip
+
+Also importable (tests/test_observability.py): `render_report(snapshot)`
+returns the full text.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _tree_from_spans(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Nest one trace's flat span records parent->children (the same
+    shape core.telemetry.span_tree builds from its live store)."""
+    nodes = {s["span_id"]: dict(s, children=[]) for s in spans}
+    roots: List[Dict[str, Any]] = []
+    for s in sorted(nodes.values(), key=lambda r: r.get("t_start", 0.0)):
+        parent = nodes.get(s.get("parent_id")) if s.get("parent_id") else None
+        if parent is not None:
+            parent["children"].append(s)
+        else:
+            roots.append(s)
+    return roots
+
+
+def render_report(snapshot: Dict[str, Any], trace_id: Optional[str] = None,
+                  top: int = 5) -> str:
+    """The full human-readable report: latency table + span trees for
+    the `top` largest traces (or just `trace_id`'s)."""
+    from mmlspark_tpu.core.telemetry import (format_latency_table,
+                                             format_span_tree)
+
+    lines: List[str] = []
+    hists = snapshot.get("histograms", {})
+    if hists:
+        lines.append("== latency table (seconds unless the name says "
+                     "bytes) ==")
+        lines.append(format_latency_table(hists))
+        lines.append("")
+    counters = snapshot.get("counters", {})
+    if counters:
+        lines.append("== counters ==")
+        for k in sorted(counters):
+            lines.append(f"  {k} = {counters[k]}")
+        lines.append("")
+    gauges = snapshot.get("gauges", {})
+    if gauges:
+        lines.append("== gauges ==")
+        for k in sorted(gauges):
+            lines.append(f"  {k} = {gauges[k]}")
+        lines.append("")
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in snapshot.get("spans", []):
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    if trace_id is not None:
+        picked = [trace_id] if trace_id in by_trace else []
+        if not picked:
+            lines.append(f"trace {trace_id!r} not in snapshot")
+    else:
+        # biggest traces first: the interesting request is usually the
+        # one that touched the most machinery
+        picked = sorted(by_trace, key=lambda t: -len(by_trace[t]))[:top]
+    if picked:
+        lines.append(f"== span trees ({len(picked)} of "
+                     f"{len(by_trace)} traces) ==")
+        for tid in picked:
+            lines.append(f"trace {tid} ({len(by_trace[tid])} spans)")
+            lines.append(format_span_tree(_tree_from_spans(by_trace[tid])))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _demo_snapshot() -> Dict[str, Any]:
+    """A real serving round-trip on this host (CPU devices are fine):
+    identity-ish model behind ServingServer, a few traced requests, then
+    the live snapshot."""
+    import numpy as np
+
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+    from mmlspark_tpu.io.feed import DeviceFeed
+    from mmlspark_tpu.io.http.clients import send_request
+    from mmlspark_tpu.io.http.schema import to_http_request
+    from mmlspark_tpu.serving.server import ServingServer
+
+    feed = DeviceFeed()
+
+    def fn(table):
+        v = np.asarray(table["v"], np.float32)
+        dv = feed.put(v)
+        return table.with_column("y", np.asarray(dv) * 2.0)
+
+    srv = ServingServer(LambdaTransformer(fn), reply_col="y",
+                        name="obs-demo", path="/demo", input_schema=["v"])
+    info = srv.start()
+    try:
+        for i in range(4):
+            resp = send_request(to_http_request(
+                info.url, {"v": float(i)},
+                headers={"X-Trace-Id": f"demotrace{i:03d}"}))
+            assert resp.status_code == 200, resp.status_code
+    finally:
+        srv.stop()
+    return telemetry.export_snapshot()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="export_snapshot() JSON file "
+                         "(chaos_soak --obs-out)")
+    ap.add_argument("--trace", default=None,
+                    help="render only this trace id's tree")
+    ap.add_argument("--top", type=int, default=5,
+                    help="how many (largest) traces to render")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a tiny live serving round-trip and report it")
+    args = ap.parse_args(argv)
+    if args.demo:
+        snapshot = _demo_snapshot()
+    elif args.snapshot is not None:
+        snapshot = json.loads(Path(args.snapshot).read_text())
+    else:
+        ap.error("need a SNAPSHOT.json or --demo")
+    print(render_report(snapshot, trace_id=args.trace, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
