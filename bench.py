@@ -351,10 +351,13 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
                                use_pallas=False)
         feat.transform(table)
     from mmlspark_tpu.io.feed import FEED_TELEMETRY, FeedTelemetry
+    from mmlspark_tpu.io.pipeline import PIPELINE_TELEMETRY
 
     feed_since = FEED_TELEMETRY.snapshot()
+    pipe_since = PIPELINE_TELEMETRY.snapshot()
+    reps = 3
     e2e_dt = None
-    for _ in range(3):  # tunneled-chip timings are noisy: best of 3
+    for _ in range(reps):  # tunneled-chip timings are noisy: best of 3
         t0 = time.perf_counter()
         out_table = feat.transform(table)
         dt = time.perf_counter() - t0
@@ -365,7 +368,29 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     # achieved wire bandwidth, the fraction of feed wall time hidden
     # under device compute, and the host-side stall budget — these are
     # what distinguish "the link is slow" from "the feed is serializing"
-    feed = FeedTelemetry.summarize(FEED_TELEMETRY.delta(feed_since))
+    feed_delta = FEED_TELEMETRY.delta(feed_since)
+    feed = FeedTelemetry.summarize(feed_delta)
+    # per-stage breakdown off the input pipeline's stage counters + the
+    # feed's transfer/compute counters, averaged per transform: where
+    # each image's wall time actually went.  busy_s sums over workers,
+    # so a stage's ms can exceed e2e wall when its workers overlap —
+    # exactly the signal that the stage is parallelized away.
+    pipe_delta = PIPELINE_TELEMETRY.delta(pipe_since)
+
+    def _stage_ms(name):
+        rec = pipe_delta.get(name)
+        if not rec or not rec.get("items"):
+            return None
+        return round(rec["busy_s"] / reps * 1e3, 1)
+
+    stage_ms = {
+        "decode_ms": _stage_ms("decode"),
+        "host_assemble_ms": _stage_ms("assemble"),
+        "h2d_ms": round(feed_delta.get("transfer_s", 0.0) / reps * 1e3, 1),
+        "forward_ms": round((feed_delta.get("compute_s", 0.0)
+                             + feed_delta.get("stall_drain_s", 0.0))
+                            / reps * 1e3, 1),
+    }
     # the registry view of the same run: per-transfer latency tail off the
     # io.feed.transfer.latency histogram (summarize's counters are totals
     # only — the p95 is what catches a bimodal link)
@@ -385,17 +410,20 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
         "feed_gbps": feed["h2d_gbps"],
         "feed_transfer_calls": feed["transfer_calls"],
         "feed_transfer_p95_ms": feed_p95_ms,
+        **{k: v for k, v in stage_ms.items() if v is not None},
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
     }
+    # e2e_bound: the stage the pipeline actually spent the most host-
+    # visible time in during the measured transforms (the old coarse
+    # standalone probes stay in the record as decode_ips/h2d_ips for
+    # cross-checking, but no longer drive the attribution)
+    bound = {k[:-3].rstrip("_"): v for k, v in stage_ms.items()
+             if v is not None and v > 0}
+    if bound:
+        out["e2e_bound"] = max(bound, key=bound.get)
     try:
-        bn = _measure_bottlenecks(table)
-        out.update(bn)
-        stages = {"decode": bn.get("decode_ips"), "h2d": bn.get("h2d_ips"),
-                  "forward": round(forward_ips, 1)}
-        stages = {k: v for k, v in stages.items() if v}
-        if stages:
-            out["e2e_bound"] = min(stages, key=stages.get)
+        out.update(_measure_bottlenecks(table))
     except Exception as e:  # noqa: BLE001 — diagnostics must not kill the record
         out["bottleneck_error"] = str(e)[-200:]
     if pallas_fallback:
@@ -553,7 +581,9 @@ def main():
         "mfu": res["mfu"],
         **{k: res[k] for k in ("decode_ips", "h2d_gbps", "h2d_ips",
                                "overlap_frac", "stall_s", "feed_gbps",
-                               "feed_transfer_calls",
+                               "feed_transfer_calls", "feed_transfer_p95_ms",
+                               "decode_ms", "host_assemble_ms",
+                               "h2d_ms", "forward_ms",
                                "e2e_bound", "bottleneck_error",
                                "pallas_fallback") if k in res},
         "cifar10_train_samples_per_sec": train.get("train_samples_per_sec"),

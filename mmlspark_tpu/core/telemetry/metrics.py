@@ -50,12 +50,14 @@ DECLARED_METRICS: Dict[str, str] = {
     "faults.injected": "counter",         # + .<fault-point> variants
     "training.autosave": "counter",
     "training.resume": "counter",
+    "io.pipeline.items": "counter",       # + .<stage> variants
     # -- histograms
     "serving.request.latency": "histogram",
     "serving.batch.fill": "histogram",
     "serving.batcher.batch_fill": "histogram",
     "io.feed.transfer.latency": "histogram",
     "io.feed.transfer.bytes": "histogram",
+    "io.pipeline.stage.latency": "histogram",   # labeled {stage=...}
     "io.http.request.latency": "histogram",
     "models.training.step_latency": "histogram",
     # -- gauges
@@ -64,6 +66,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.feed.degraded_engines": "gauge",
     "io.feed.overlap_frac": "gauge",
     "io.feed.stall_s": "gauge",
+    "io.pipeline.queue.depth": "gauge",   # + .<stage> variants
     "models.training.examples_per_sec": "gauge",
 }
 

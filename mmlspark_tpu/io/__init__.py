@@ -1,1 +1,4 @@
-from .feed import DeviceFeed, FeedTelemetry, FEED_TELEMETRY, default_depth
+from .feed import (DeviceFeed, FeedTelemetry, FEED_TELEMETRY, FeedSource,
+                   FEED_END, default_depth)
+from .pipeline import (HostPipeline, PipelineStage, PipelineTelemetry,
+                       PIPELINE_TELEMETRY, pipeline_workers)

@@ -61,9 +61,86 @@ import numpy as np
 from ..core import telemetry as core_telemetry
 from ..utils.faults import fault_point
 
-__all__ = ["DeviceFeed", "FeedTelemetry", "FEED_TELEMETRY", "default_depth"]
+__all__ = ["DeviceFeed", "FeedTelemetry", "FEED_TELEMETRY", "default_depth",
+           "FeedSource", "FEED_END"]
 
 _ALIGN = 128  # byte-pack offset alignment (covers every feed dtype's itemsize)
+
+# terminal marker a FeedSource returns once its stream is exhausted
+FEED_END = object()
+
+
+class FeedSource:
+    """Protocol for multi-producer chunk sources driving `DeviceFeed.run`.
+
+    PR 2's `run()` hid exactly one prefetch thread behind a plain
+    iterator — decode AND assembly serialized on it.  A FeedSource owns
+    its production concurrency (the HostPipeline adapter in
+    io/pipeline.py runs N decode workers) and the feed engine just pulls
+    ready chunks:
+
+      * ``start()``    — begin producing (called once by `run`).
+      * ``get()``      — block until the next (chunk, n_valid) item, or
+                         return ``FEED_END`` when the stream is done
+                         (terminal: keep returning it).
+      * ``get_nowait()``— same, but raise ``queue.Empty`` instead of
+                         blocking when nothing is ready yet.
+      * ``error()``    — the producer-side exception to re-raise after
+                         in-flight groups drain, or None.
+
+    Plain iterables passed to `run()` are wrapped in `_IterSource`,
+    which reproduces the old single-prefetch-thread behavior exactly —
+    the original signature keeps working."""
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def get(self):
+        raise NotImplementedError
+
+    def get_nowait(self):
+        raise NotImplementedError
+
+    def error(self) -> Optional[BaseException]:
+        return None
+
+
+class _IterSource(FeedSource):
+    """The PR-2 shape: one daemon thread drains `chunk_iter` into a
+    bounded queue (decode/assembly overlap device compute; backpressure
+    via maxsize)."""
+
+    def __init__(self, chunk_iter: Iterable, maxsize: int):
+        self._it = chunk_iter
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._err: List[BaseException] = []
+
+    def start(self):
+        threading.Thread(target=self._produce, daemon=True,
+                         name="device-feed-producer").start()
+
+    def _produce(self):
+        try:
+            for item in self._it:
+                self._q.put(item)
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            self._err.append(e)
+        finally:
+            self._q.put(FEED_END)
+
+    def _terminal(self, item):
+        if item is FEED_END:
+            self._q.put(FEED_END)  # stay terminal for later gets
+        return item
+
+    def get(self):
+        return self._terminal(self._q.get())
+
+    def get_nowait(self):
+        return self._terminal(self._q.get_nowait())
+
+    def error(self) -> Optional[BaseException]:
+        return self._err[0] if self._err else None
 
 
 def default_depth() -> int:
@@ -88,7 +165,7 @@ class FeedTelemetry:
 
     _FIELDS = ("bytes_moved", "transfer_calls", "transfer_s", "chunks_fed",
                "coalesced_chunks", "groups", "stall_decode_s",
-               "stall_drain_s", "wall_s")
+               "stall_drain_s", "compute_s", "wall_s")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -343,19 +420,23 @@ class DeviceFeed:
         self.telemetry.add(wall_s=time.perf_counter() - t0)
 
     # ---- the pipelined chunk engine ------------------------------------
-    def run(self, chunk_iter: Iterable[Tuple[np.ndarray, int]],
-            compute_fn: Callable, greedy: bool = True) -> List[np.ndarray]:
+    def run(self, chunk_iter, compute_fn: Callable,
+            greedy: bool = True) -> List[np.ndarray]:
         """Drive (chunk, n_valid) pairs through transfer + compute with
         decode/transfer/compute overlap; returns per-chunk host outputs
         trimmed to n_valid, in feed order.
 
-        `chunk_iter` runs on a prefetch thread (decode/assembly overlap
-        device compute).  Ready chunks coalesce into packed groups (same
-        shape/dtype: one [k, bs, ...] buffer; mixed on a single device:
-        one byte-packed buffer); each group is ONE `device_put`, split
-        apart on device by a donated unpack program, and `compute_fn` is
-        dispatched per chunk.  Up to `depth` groups are in flight; the
-        oldest drains (async-fetched) when the window fills.
+        `chunk_iter` is either a plain iterable — it runs on ONE
+        prefetch thread (`_IterSource`; decode/assembly overlap device
+        compute) — or a `FeedSource` that owns its own production
+        concurrency (HostPipeline's N decode workers feed the same
+        consumer loop; io/pipeline.py).  Ready chunks coalesce into
+        packed groups (same shape/dtype: one [k, bs, ...] buffer; mixed
+        on a single device: one byte-packed buffer); each group is ONE
+        `device_put`, split apart on device by a donated unpack program,
+        and `compute_fn` is dispatched per chunk.  Up to `depth` groups
+        are in flight; the oldest drains (async-fetched) when the window
+        fills.
 
         greedy=True never waits for a fuller pack (latency-first; the
         transform path).  greedy=False waits until `coalesce` chunks are
@@ -366,22 +447,13 @@ class DeviceFeed:
 
         tel = self.telemetry
         t_wall = time.perf_counter()
-        q: "queue.Queue" = queue.Queue(maxsize=max(4 * self.coalesce,
-                                                   2 * self.depth))
-        sentinel = object()
-        err: List[BaseException] = []
-
-        def produce():
-            try:
-                for item in chunk_iter:
-                    q.put(item)
-            except BaseException as e:  # noqa: BLE001 — forwarded to consumer
-                err.append(e)
-            finally:
-                q.put(sentinel)
-
-        threading.Thread(target=produce, daemon=True,
-                         name="device-feed-producer").start()
+        if isinstance(chunk_iter, FeedSource):
+            source = chunk_iter
+        else:
+            source = _IterSource(chunk_iter,
+                                 maxsize=max(4 * self.coalesce,
+                                             2 * self.depth))
+        source.start()
 
         results: List[np.ndarray] = []
         inflight: deque = deque()  # (ys, ns, slot) per group, feed order
@@ -412,14 +484,14 @@ class DeviceFeed:
             while len(group) < coalesce_now and gbytes < self.coalesce_bytes:
                 if not group or (not greedy and not done):
                     t0 = time.perf_counter()
-                    item = q.get()
+                    item = source.get()
                     tel.add(stall_decode_s=time.perf_counter() - t0)
                 else:
                     try:
-                        item = q.get_nowait()
+                        item = source.get_nowait()
                     except queue.Empty:
                         break
-                if item is sentinel:
+                if item is FEED_END:
                     done = True
                     break
                 chunk, n = item
@@ -433,6 +505,7 @@ class DeviceFeed:
 
             # ---- one transfer for the whole group ----
             xs, slot = self._transfer_group(group)
+            t0 = time.perf_counter()
             ys = []
             for x in xs:
                 ys.append(compute_fn(x))
@@ -443,14 +516,19 @@ class DeviceFeed:
                     y.copy_to_host_async()
                 except (AttributeError, NotImplementedError):
                     pass
+            # dispatch time; the blocked remainder of device compute
+            # lands in stall_drain_s — the sum is the forward's
+            # host-visible cost (bench.py's forward_ms)
+            tel.add(compute_s=time.perf_counter() - t0)
             inflight.append((ys, [n for _c, n in group], slot))
             while len(inflight) > (0 if self.degraded else self.depth):
                 drain_group()
         while inflight:
             drain_group()
         tel.add(wall_s=time.perf_counter() - t_wall)
-        if err:
-            raise err[0]
+        src_err = source.error()
+        if src_err is not None:
+            raise src_err
         return results
 
     # ---- packing internals ---------------------------------------------

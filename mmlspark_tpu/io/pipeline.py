@@ -1,0 +1,392 @@
+"""HostPipeline: the streaming input pipeline engine.
+
+BENCH_r05 measured the gap this module closes: the ResNet-50 forward
+sustains 11,167 images/sec while end-to-end ImageFeaturizer delivers
+134.4 — the host stages (decode -> assemble -> h2d -> forward) ran
+largely serially per batch, so e2e throughput was the SUM of stage
+times instead of the MAX.  This is the pipelined-prefetch argument of
+tf.data (Murray et al., VLDB 2021) and DALI's move-preprocessing-to-
+accelerator design, applied to this stack:
+
+  * **Stages with worker pools.**  A `HostPipeline` is an ordered list
+    of `PipelineStage(name, fn, workers)` map stages.  Each stage owns
+    `workers` threads pulling from a bounded input queue; the decode
+    codecs (libjpeg via `native`, PIL) release the GIL, so N decode
+    workers decode N chunks concurrently while later stages and the
+    device run ahead on earlier ones.
+  * **Bounded hand-off queues = backpressure.**  Every stage boundary
+    is a bounded queue; a slow device stalls assembly, which stalls
+    decode — memory stays O(queue_size x chunk), never O(dataset).
+  * **Order-preserving emission.**  Workers finish out of order; a
+    per-stage reorder buffer re-emits results in sequence so chunk
+    results land in feed order and the DeviceFeed's coalescer still
+    sees same-shape runs back to back.
+  * **Feeds DeviceFeed directly.**  `feed_source(items)` adapts the
+    pipeline's ordered output to the feed engine's `FeedSource`
+    protocol (io/feed.py), so decode of chunk N+2, h2d of N+1, and the
+    forward of N are in flight simultaneously with no extra copy or
+    hand-off thread in between.
+  * **Telemetry.**  Per-stage busy seconds and item counts accumulate
+    in `PIPELINE_TELEMETRY` (bench.py derives `decode_ms` /
+    `host_assemble_ms` and the `e2e_bound` attribution from deltas);
+    each item observes `io.pipeline.stage.latency{stage=...}`, queue
+    depths mirror to `io.pipeline.queue.depth.<stage>` gauges, and when
+    the submitting thread is inside a trace every stage item records a
+    `pipeline.<stage>` child span — `/trace/<id>` shows decode spans of
+    later batches overlapping the transfer/forward of earlier ones.
+
+Failure semantics: a stage exception (or a producer exception) cancels
+the pipeline, and the consumer re-raises the ORIGINAL error — no
+deadlock, no silent truncation.  All queue waits are cancel-aware
+timeout loops, so an abandoned consumer (generator closed early) or a
+dead consumer can never strand a worker.  See docs/performance.md
+("The input pipeline").
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+from ..core import telemetry as core_telemetry
+from .feed import FEED_END, FeedSource
+
+__all__ = ["PipelineStage", "HostPipeline", "PipelineTelemetry",
+           "PIPELINE_TELEMETRY", "pipeline_workers"]
+
+_POLL_S = 0.05  # cancel-aware queue wait quantum
+
+
+def pipeline_workers(default: Optional[int] = None) -> int:
+    """Decode/assembly worker count: MMLSPARK_PIPELINE_WORKERS overrides
+    (the knob every adopter inherits); otherwise `default`, otherwise a
+    conservative min(4, cores) — decode threads beyond the core count
+    only add queue contention."""
+    env = os.environ.get("MMLSPARK_PIPELINE_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    if default is not None:
+        return max(1, int(default))
+    return max(1, min(4, os.cpu_count() or 2))
+
+
+class PipelineTelemetry:
+    """Thread-safe per-stage busy-seconds / item counters.
+
+    `busy_s` for a stage is the sum of wall time its workers spend
+    inside the stage fn — items/busy_s is the stage's standalone
+    throughput bound, which is exactly what `e2e_bound` attribution
+    needs (the pipeline's steady-state rate is min over stages of
+    items/busy_s x workers)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stages: Dict[str, Dict[str, float]] = {}
+
+    def add(self, stage: str, busy_s: float = 0.0, items: int = 0):
+        with self._lock:
+            rec = self._stages.setdefault(stage,
+                                          {"busy_s": 0.0, "items": 0.0})
+            rec["busy_s"] += busy_s
+            rec["items"] += items
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stages.items()}
+
+    def delta(self, since: Dict[str, Dict[str, float]]
+              ) -> Dict[str, Dict[str, float]]:
+        now = self.snapshot()
+        out = {}
+        for k, v in now.items():
+            base = since.get(k, {})
+            out[k] = {f: v[f] - base.get(f, 0.0) for f in v}
+        return out
+
+
+# process-wide default sink: bench.py and tests read deltas off this
+PIPELINE_TELEMETRY = PipelineTelemetry()
+
+
+class PipelineStage:
+    """One map stage: `fn(value) -> value`, run by `workers` threads.
+
+    `fn` must be thread-safe for workers > 1 (the decode/assembly fns
+    here close over read-only inputs and write disjoint outputs)."""
+
+    def __init__(self, name: str, fn: Callable[[Any], Any],
+                 workers: int = 1):
+        self.name = str(name)
+        self.fn = fn
+        self.workers = max(1, int(workers))
+
+
+class _EOF:
+    """End-of-stream marker carrying the total item count; re-put by the
+    worker that pops it so every sibling sees it, forwarded downstream
+    by the reorder buffer only after all `total` items emitted."""
+
+    __slots__ = ("total",)
+
+    def __init__(self, total: int):
+        self.total = total
+
+
+class _Reorder:
+    """Order-restoring emitter between a stage's workers and the next
+    queue: out-of-order completions park in `pending` until their turn.
+    `put` may block on a full downstream queue while the lock is held —
+    that IS the backpressure (siblings stall on the lock instead of
+    racing further ahead); the consumer side never takes this lock, so
+    there is no cycle to deadlock on."""
+
+    def __init__(self, put: Callable[[Any], None]):
+        self._put = put
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Any] = {}
+        self._next = 0
+        self._total: Optional[int] = None
+        self._eof_sent = False
+
+    def emit(self, seq: int, value: Any):
+        with self._lock:
+            self._pending[seq] = value
+            self._flush()
+
+    def close(self, total: int):
+        with self._lock:
+            self._total = total
+            self._flush()
+
+    def _flush(self):
+        while self._next in self._pending:
+            self._put((self._next, self._pending.pop(self._next)))
+            self._next += 1
+        if (self._total is not None and self._next >= self._total
+                and not self._eof_sent):
+            self._eof_sent = True
+            self._put(_EOF(self._total))
+
+
+class HostPipeline:
+    """Bounded multi-stage streaming pipeline over an item iterable.
+
+    Drive it one of three ways:
+      * `run(items)` — iterate the ordered final-stage outputs;
+      * `feed_source(items)` — a `FeedSource` for `DeviceFeed.run`
+        (the chunk path: stage outputs must be (chunk, n_valid) pairs);
+      * `start(items)` + manual draining (tests).
+
+    One pipeline instance is single-use (queues and counters are per
+    run); instances are cheap — threads spawn at `start`."""
+
+    def __init__(self, stages: Sequence[PipelineStage],
+                 queue_size: Optional[int] = None,
+                 telemetry: Optional[PipelineTelemetry] = None):
+        if not stages:
+            raise ValueError("HostPipeline needs at least one stage")
+        self.stages = list(stages)
+        # deep enough that every worker of the widest stage can have one
+        # item in hand and one queued; small enough to bound host memory
+        self.queue_size = max(2, int(
+            queue_size if queue_size is not None
+            else 2 * max(s.workers for s in self.stages)))
+        self.telemetry = (telemetry if telemetry is not None
+                          else PIPELINE_TELEMETRY)
+        self._queues: List["queue.Queue"] = []
+        self._qnames: List[str] = []
+        self._cancelled = threading.Event()
+        self._err_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._high_water: Dict[str, int] = {}
+        self._started = False
+        self._ctx = None  # (trace_id, span_id) captured at start
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self, items: Iterable[Any]):
+        """Spawn the producer and every stage's workers (all daemon)."""
+        if self._started:
+            raise RuntimeError("HostPipeline instances are single-use")
+        self._started = True
+        # spans from worker threads attach to the trace active where the
+        # pipeline was STARTED (the transform/fit caller), the same
+        # cross-thread hop record_span exists for
+        self._ctx = core_telemetry.current_context()
+        self._queues = [queue.Queue(maxsize=self.queue_size)
+                        for _ in self.stages]
+        self._queues.append(queue.Queue(maxsize=self.queue_size))  # out
+        self._qnames = [s.name for s in self.stages] + ["out"]
+        threading.Thread(target=self._produce, args=(items,), daemon=True,
+                         name="host-pipeline-producer").start()
+        for i, stage in enumerate(self.stages):
+            reorder = _Reorder(
+                lambda item, j=i + 1: self._q_put(j, item))
+            for w in range(stage.workers):
+                threading.Thread(
+                    target=self._worker, args=(stage, i, reorder),
+                    daemon=True,
+                    name=f"host-pipeline-{stage.name}-{w}").start()
+
+    def cancel(self):
+        """Stop all workers promptly; safe to call repeatedly."""
+        self._cancelled.set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def high_water(self) -> Dict[str, int]:
+        """Max observed depth per hand-off queue (keyed by the stage the
+        queue feeds, plus 'out') — the structural overlap witness: a
+        stage queue that reached depth >= 2 had the previous stage
+        running ahead while this one was still busy."""
+        return dict(self._high_water)
+
+    # ---- queue plumbing ------------------------------------------------
+    def _q_put(self, idx: int, item: Any):
+        q = self._queues[idx]
+        name = self._qnames[idx]
+        while not self._cancelled.is_set():
+            try:
+                q.put(item, timeout=_POLL_S)
+                break
+            except queue.Full:
+                continue
+        depth = q.qsize()
+        if depth > self._high_water.get(name, 0):
+            self._high_water[name] = depth
+        core_telemetry.gauge(f"io.pipeline.queue.depth.{name}").set(depth)
+
+    def _fail(self, e: BaseException):
+        with self._err_lock:
+            if self._error is None:
+                self._error = e
+        self.cancel()
+
+    def _produce(self, items: Iterable[Any]):
+        n = 0
+        try:
+            for item in items:
+                self._q_put(0, (n, item))
+                n += 1
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            self._fail(e)
+            return
+        self._q_put(0, _EOF(n))
+
+    def _worker(self, stage: PipelineStage, idx: int, reorder: _Reorder):
+        in_q = self._queues[idx]
+        while not self._cancelled.is_set():
+            try:
+                item = in_q.get(timeout=_POLL_S)
+            except queue.Empty:
+                continue
+            if isinstance(item, _EOF):
+                # sibling workers need the marker too
+                self._q_put(idx, item)
+                reorder.close(item.total)
+                return
+            seq, value = item
+            t0 = time.perf_counter()
+            try:
+                out = stage.fn(value)
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                self._fail(e)
+                return
+            dt = time.perf_counter() - t0
+            self.telemetry.add(stage.name, busy_s=dt, items=1)
+            core_telemetry.histogram("io.pipeline.stage.latency",
+                                     stage=stage.name).observe(dt)
+            core_telemetry.incr(f"io.pipeline.items.{stage.name}")
+            if self._ctx is not None:
+                core_telemetry.record_span(f"pipeline.{stage.name}",
+                                           self._ctx, dt, seq=seq)
+            reorder.emit(seq, out)
+
+    # ---- consumption ---------------------------------------------------
+    def _next_out(self, block: bool = True):
+        """Next ordered (seq, value) from the out queue; `_EOF` at clean
+        end; raises the pipeline's error, or queue.Empty when
+        non-blocking and nothing is ready."""
+        q = self._queues[-1]
+        while True:
+            try:
+                item = q.get(block=block, timeout=_POLL_S if block else None)
+            except queue.Empty:
+                if self._error is not None:
+                    raise self._error
+                if self._cancelled.is_set():
+                    raise RuntimeError("HostPipeline cancelled")
+                if block:
+                    continue
+                raise
+            if isinstance(item, _EOF):
+                if self._error is not None:
+                    raise self._error
+                return item
+            return item
+
+    def run(self, items: Iterable[Any]):
+        """Start and iterate the ordered final-stage outputs."""
+        self.start(items)
+        try:
+            while True:
+                item = self._next_out()
+                if isinstance(item, _EOF):
+                    return
+                yield item[1]
+        finally:
+            # an abandoned/broken consumer must not strand the workers
+            self.cancel()
+
+    def feed_source(self, items: Iterable[Any]) -> "FeedSource":
+        """Adapt to DeviceFeed's `FeedSource` protocol: the feed engine
+        pulls ready (chunk, n_valid) pairs straight off the pipeline's
+        ordered out queue — N decode workers drive the feed without an
+        extra hand-off thread."""
+        return _PipelineFeedSource(self, items)
+
+
+class _PipelineFeedSource(FeedSource):
+    """FeedSource over a HostPipeline's ordered output (see
+    io/feed.py for the protocol DeviceFeed.run consumes)."""
+
+    def __init__(self, pipe: HostPipeline, items: Iterable[Any]):
+        self._pipe = pipe
+        self._items = items
+        self._done = False
+
+    def start(self):
+        self._pipe.start(self._items)
+
+    def _translate(self, block: bool):
+        if self._done:
+            return FEED_END
+        try:
+            item = self._pipe._next_out(block=block)
+        except queue.Empty:
+            raise
+        except BaseException:  # noqa: BLE001 — surfaced via error()
+            # feed.run raises source.error() after draining in-flight
+            # work, so the error still propagates — without deadlocking
+            # the transfer window mid-group
+            self._done = True
+            return FEED_END
+        if isinstance(item, _EOF):
+            self._done = True
+            return FEED_END
+        return item[1]
+
+    def get(self):
+        return self._translate(block=True)
+
+    def get_nowait(self):
+        return self._translate(block=False)
+
+    def error(self) -> Optional[BaseException]:
+        return self._pipe.error

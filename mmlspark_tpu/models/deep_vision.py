@@ -118,7 +118,15 @@ class DeepVisionClassifier(Estimator):
         if not keep:
             raise ValueError("DeepVisionClassifier: no decodable training "
                              "rows in the input table")
-        x = np.stack([to_hw(arrays[i]) for i in keep]).astype(np.uint8)
+        from ..io.pipeline import HostPipeline, PipelineStage, pipeline_workers
+
+        # PIL's resize releases the GIL: the ragged-input fixups run
+        # thread-parallel through the input pipeline (order-preserving,
+        # bounded memory) instead of one row at a time on the caller
+        resize_pipe = HostPipeline([PipelineStage(
+            "resize", lambda i: to_hw(arrays[i]),
+            workers=pipeline_workers() if len(keep) > 32 else 1)])
+        x = np.stack(list(resize_pipe.run(keep))).astype(np.uint8)
 
         builder = get_builder(self.backbone)
         model = builder(num_classes=num_classes, dtype=jnp.bfloat16)
@@ -215,14 +223,26 @@ class DeepVisionClassifier(Estimator):
                 # shape for the whole fit); -1 labels carry zero loss
                 pad = n_steps * bs - len(order)
                 idx = np.concatenate([order, order[-1:].repeat(pad)])
-                xb = x[idx].reshape(n_steps, bs, *x.shape[1:])
-                yb = np.concatenate(
-                    [y[order], np.full(pad, -1, np.int32)]
-                ).reshape(n_steps, bs)
+                ypad = np.concatenate(
+                    [y[order], np.full(pad, -1, np.int32)])
                 losses = []
-                slices = ((xb[s : s + k], yb[s : s + k])
-                          for s in range(0, n_steps, k))
-                for dxb, dyb in feed.stream(slices, shardings=(sh, sh)):
+
+                def assemble(bounds, idx=idx, ypad=ypad):
+                    # per-slice shuffled gather on a pipeline worker:
+                    # slice t+1 assembles while slice t's epoch computes,
+                    # and the fit never materializes a full shuffled
+                    # dataset copy
+                    s, e = bounds
+                    sel = idx[s * bs : e * bs]
+                    return (x[sel].reshape(e - s, bs, *x.shape[1:]),
+                            ypad[s * bs : e * bs].reshape(e - s, bs))
+
+                pipe = HostPipeline([PipelineStage(
+                    "assemble", assemble, workers=pipeline_workers(2))])
+                bounds = [(s, min(s + k, n_steps))
+                          for s in range(0, n_steps, k)]
+                for dxb, dyb in feed.stream(pipe.run(bounds),
+                                            shardings=(sh, sh)):
                     state, ls = epoch(state, dxb, dyb)
                     losses.append(np.asarray(ls))
                 history.append(float(np.mean(np.concatenate(losses))))

@@ -178,15 +178,19 @@ class ImageFeaturizer(Transformer):
         model = self._model_for(bundle, self.input_col)
         dev_vars, jitted, mesh = model._executor(
             bundle, model._fetch_name(bundle))
-        # `failed` is appended by build_chunk on the prefetch thread and read
-        # only after run_grouped returns (the producer is exhausted by then)
+        # `failed` is appended by decode workers (list.append is atomic) and
+        # read only after run_chunk_iter returns (producers exhausted by then)
         failed: List[int] = []  # rows whose pixel decode failed every way
         results: List[Any] = [None] * n
 
-        # All shape groups feed through ONE bounded in-flight window
-        # (TPUModel.run_grouped) so the transfer/compute overlap never drains
-        # at a group boundary; native JPEG decode fills each chunk buffer on
-        # the prefetch thread, overlapped with device compute.
+        # The streaming pipeline: N decode workers fill chunk buffers in
+        # parallel (libjpeg releases the GIL), the assemble stage pads them
+        # to the plan's static shape, and the feed engine transfers/computes
+        # — decode of chunk N+2, h2d of N+1, and the forward of N are in
+        # flight at once, with every shape group sharing ONE bounded
+        # in-flight window so the overlap never drains at a group boundary.
+        from ..io.pipeline import HostPipeline, PipelineStage, pipeline_workers
+        from ..parallel.mesh import pad_to_multiple
 
         def build_chunk(shape, sel):
             gh, gw, gc = shape
@@ -206,8 +210,22 @@ class ImageFeaturizer(Transformer):
                         failed.append(i)
             return buf
 
-        feed_order, out_rows = model.run_grouped(
-            groups, build_chunk, jitted, dev_vars, mesh)
+        def decode_stage(item):
+            sel, shape, pad_mult = item
+            return build_chunk(shape, sel), pad_mult
+
+        def assemble_stage(payload):
+            buf, pad_mult = payload
+            return pad_to_multiple(buf, pad_mult, axis=0)
+
+        plan, feed_order = model.chunk_plan(groups, mesh)
+        pipe = HostPipeline([
+            PipelineStage("decode", decode_stage,
+                          workers=pipeline_workers() if len(plan) > 1 else 1),
+            PipelineStage("assemble", assemble_stage),
+        ])
+        out_rows = model.run_chunk_iter(
+            pipe.feed_source(plan), jitted, dev_vars, mesh)
         for i, y in zip(feed_order, out_rows):
             results[i] = np.asarray(y).reshape(-1)
 

@@ -253,32 +253,48 @@ class TPUModel(Transformer):
             jitted, dev_vars, mesh)
         return out  # single group: feed order == row order
 
-    def run_grouped(self, groups, build_chunk, jitted, dev_vars, mesh):
-        """Feed ordered shape groups through ONE bounded in-flight window and
-        return (feed_order, rows-in-feed-order).  Chunks of different shapes
-        interleave through the same pipeline (jax.jit caches one compiled
-        program per shape), so the transfer/compute overlap never drains at a
-        group boundary — through a high-latency link (the tunneled chip) each
-        drain is a full round-trip bubble per group.  The chunk plan is laid
-        out eagerly here, so chunk sizing/padding lives in exactly one place
-        for the row path and ImageFeaturizer's streaming byte path (the
-        chunk_sizes invariant), and the prefetch thread shares no mutable
-        state with the caller.  `build_chunk(shape, sel)` returns the stacked
-        [len(sel), ...] feed chunk for those row indices; it runs on the
-        prefetch thread so decode/assembly overlap device compute."""
+    def chunk_plan(self, groups, mesh):
+        """Lay out the chunk plan eagerly: [(sel, shape, pad_mult)] in feed
+        order plus the flattened row feed_order.  Chunk sizing/padding lives
+        in exactly one place for the row path and ImageFeaturizer's streaming
+        byte path (the chunk_sizes invariant), and the assembly workers share
+        no mutable state with the caller."""
         dp = mesh.shape["data"]
         plan = []  # (sel, shape, pad_mult) per chunk, in feed order
         for shape, idxs in groups.items():
             bs, pad_mult = self.chunk_sizes(len(idxs), dp)
             for start in range(0, len(idxs), bs):
                 plan.append((idxs[start:start + bs], shape, pad_mult))
-        feed_order = [i for sel, _, _ in plan for i in sel]
+        return plan, [i for sel, _, _ in plan for i in sel]
 
-        def chunks():
-            for sel, shape, pad_mult in plan:
-                yield pad_to_multiple(build_chunk(shape, sel), pad_mult, axis=0)
+    def run_grouped(self, groups, build_chunk, jitted, dev_vars, mesh):
+        """Feed ordered shape groups through ONE bounded in-flight window and
+        return (feed_order, rows-in-feed-order).  Chunks of different shapes
+        interleave through the same pipeline (jax.jit caches one compiled
+        program per shape), so the transfer/compute overlap never drains at a
+        group boundary — through a high-latency link (the tunneled chip) each
+        drain is a full round-trip bubble per group.  `build_chunk(shape,
+        sel)` returns the stacked [len(sel), ...] feed chunk for those row
+        indices; it runs on the HostPipeline's assembly workers
+        (io/pipeline.py) so several chunks assemble in parallel while the
+        feed engine transfers earlier ones and the device computes — the
+        order-preserving pipeline keeps same-shape runs adjacent for the
+        feed's coalescer, and its bounded queues backpressure assembly when
+        the device falls behind.  `build_chunk` must be thread-safe (the
+        builders here close over read-only row data)."""
+        from ..io.pipeline import HostPipeline, PipelineStage, pipeline_workers
 
-        return feed_order, self.run_chunk_iter(chunks(), jitted, dev_vars, mesh)
+        plan, feed_order = self.chunk_plan(groups, mesh)
+
+        def assemble(item):
+            sel, shape, pad_mult = item
+            return pad_to_multiple(build_chunk(shape, sel), pad_mult, axis=0)
+
+        pipe = HostPipeline([PipelineStage(
+            "assemble", assemble,
+            workers=pipeline_workers() if len(plan) > 1 else 1)])
+        return feed_order, self.run_chunk_iter(
+            pipe.feed_source(plan), jitted, dev_vars, mesh)
 
     def chunk_sizes(self, n_rows: int, dp: int):
         """(chunk_size, pad_multiple) for a group of n_rows: chunk size is
@@ -297,10 +313,10 @@ class TPUModel(Transformer):
     def run_chunk_iter(self, chunk_iter, jitted, dev_vars, mesh) -> List[np.ndarray]:
         """Drive (padded_chunk, n_valid) pairs through the executor via the
         DeviceFeed engine; returns the per-row outputs in order.
-        `chunk_iter` runs on the feed's prefetch thread (decode/assembly
-        overlap device compute), same-shape chunks coalesce into single
-        packed transfers, and `feed_depth` transfer groups stay in
-        flight."""
+        `chunk_iter` is a plain iterable (one prefetch thread) or a
+        `FeedSource` (a HostPipeline's N assembly/decode workers);
+        same-shape chunks coalesce into single packed transfers, and
+        `feed_depth` transfer groups stay in flight."""
         from ..io.feed import DeviceFeed
 
         feed = DeviceFeed(mesh=mesh, depth=int(self.feed_depth))

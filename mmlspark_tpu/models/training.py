@@ -284,6 +284,7 @@ def fit_epochs(
             f"dataset has {n} rows < batch_size {batch_size}; lower batch_size"
         )
     from ..io.feed import DeviceFeed
+    from ..io.pipeline import HostPipeline, PipelineStage, pipeline_workers
 
     rng = np.random.default_rng(seed)
     metrics: Dict[str, float] = {}
@@ -297,15 +298,31 @@ def fit_epochs(
         if epoch_fn is not None:
             steps = n // batch_size
             idx = order[: steps * batch_size]
-            bi = images[idx].reshape(steps, batch_size, *images.shape[1:])
-            bl = labels[idx].reshape(steps, batch_size)
             # scan in bounded slices: device memory stays O(slice) even for
             # datasets far larger than HBM; at most two compiled shapes
             # (the full slice and one remainder) across the whole fit
-            k = scan_slice_steps(steps, bi[0].nbytes + bl[0].nbytes)
-            slices = ((bi[s : s + k], bl[s : s + k])
-                      for s in range(0, steps, k))
-            for dbi, dbl in feed.stream(slices, shardings=(img_sh, img_sh)):
+            step_bytes = (batch_size * int(np.prod(images.shape[1:]))
+                          * images.dtype.itemsize
+                          + batch_size * labels.dtype.itemsize)
+            k = scan_slice_steps(steps, step_bytes)
+
+            def assemble(bounds, idx=idx):
+                # per-slice shuffled gather on a pipeline worker: slice
+                # t+1 assembles (and its transfer prefetches) while slice
+                # t's scanned epoch computes — and the epoch no longer
+                # materializes a full shuffled copy of the dataset up
+                # front; host memory stays O(slice)
+                s, e = bounds
+                sel = idx[s * batch_size : e * batch_size]
+                return (images[sel].reshape(e - s, batch_size,
+                                            *images.shape[1:]),
+                        labels[sel].reshape(e - s, batch_size))
+
+            pipe = HostPipeline([PipelineStage(
+                "assemble", assemble, workers=pipeline_workers(2))])
+            bounds = [(s, min(s + k, steps)) for s in range(0, steps, k)]
+            for dbi, dbl in feed.stream(pipe.run(bounds),
+                                        shardings=(img_sh, img_sh)):
                 t0 = time.perf_counter()
                 state, ms = epoch_fn(state, dbi, dbl)
                 # one scanned dispatch = len(dbi) optimizer steps; block

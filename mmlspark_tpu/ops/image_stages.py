@@ -63,15 +63,49 @@ def decode_cells(col: np.ndarray) -> list:
     for undecodable cells).  PIL's and the native decoder's codecs release
     the GIL, so larger columns decode thread-parallel — the shared host
     decode policy of ImageFeaturizer/DeepVisionClassifier (the reference
-    decodes per-row on JVM task threads, ImageUtils.scala:26)."""
-    import os
+    decodes per-row on JVM task threads, ImageUtils.scala:26).
 
-    if len(col) > 32:
+    Cells that are already decoded (image-row dicts, ndarray pixels) are
+    short-circuited inline BEFORE the pool: only encoded bytes pay a
+    codec, and a column of mostly-decoded rows with a few encoded
+    stragglers no longer spins up 16 threads to re-wrap ndarrays.  Wall
+    time and item count land in the pipeline telemetry's "decode" stage
+    so bench.py's per-stage breakdown covers this path too."""
+    import os
+    import time
+
+    from ..core import telemetry as core_telemetry
+    from ..io.pipeline import PIPELINE_TELEMETRY
+
+    out: list = [None] * len(col)
+    pending: list = []  # indices still needing a codec (bytes/unknown)
+    for i, v in enumerate(col):
+        if v is None:
+            continue
+        if isinstance(v, dict):
+            out[i] = v
+        elif isinstance(v, np.ndarray) and v.ndim >= 2:
+            out[i] = array_to_image_row(v)
+        else:
+            pending.append(i)
+    if not pending:
+        return out
+    t0 = time.perf_counter()
+    if len(pending) > 32:
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=min(16, os.cpu_count() or 4)) as ex:
-            return list(ex.map(_decode_cell, col))
-    return [_decode_cell(v) for v in col]
+        with ThreadPoolExecutor(
+                max_workers=min(16, os.cpu_count() or 4)) as ex:
+            rows = list(ex.map(_decode_cell, (col[i] for i in pending)))
+    else:
+        rows = [_decode_cell(col[i]) for i in pending]
+    for i, row in zip(pending, rows):
+        out[i] = row
+    dt = time.perf_counter() - t0
+    PIPELINE_TELEMETRY.add("decode", busy_s=dt, items=len(pending))
+    core_telemetry.histogram("io.pipeline.stage.latency",
+                             stage="decode").observe(dt)
+    return out
 
 
 class _BatchedImageStage(Transformer):

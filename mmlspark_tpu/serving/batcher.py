@@ -598,10 +598,15 @@ class ContinuousBatcher:
                                      []).append((slot, req))
         if prefix_groups:
             self._admit_prefix_groups(prefix_groups)
-        for b, group in sorted(by_bucket.items()):
-            k = len(group)
+
+        def pack_bucket(item):
+            # host-side prompt packing for one bucket; runs on the input
+            # pipeline so bucket i+1 packs while bucket i's prefill
+            # forward occupies the device
+            b, group = item
+            kb = len(group)
             kp = 1
-            while kp < k:
+            while kp < kb:
                 kp *= 2
             kp = min(kp, self.max_slots)
             padded = np.zeros((kp, b), np.int32)
@@ -609,8 +614,23 @@ class ContinuousBatcher:
             for i, (slot, req) in enumerate(group):
                 padded[i, :len(req.prompt)] = req.prompt
                 slots[i] = slot
+            return group, kp, padded, slots
+
+        buckets = sorted(by_bucket.items())
+        if len(buckets) > 1:
+            from ..io.pipeline import HostPipeline, PipelineStage
+
+            packed = HostPipeline(
+                [PipelineStage("assemble", pack_bucket)]).run(buckets)
+        else:  # one bucket: nothing to overlap, skip the worker thread
+            packed = map(pack_bucket, buckets)
+        for group, kp, padded, slots in packed:
+            k = len(group)
+            # the upload rides the feed engine: counted bytes, transfer
+            # spans on the request trace, the feed.device_put fault point
+            d_padded = self._feed.put(padded)
             logits, cache = _prefill_cache(self.model, self.variables,
-                                           jnp.asarray(padded),
+                                           d_padded,
                                            self.kv_cache_dtype)
             if self.draft_model is not None:
                 # the draft's cache must hold the same prompt history;
@@ -618,7 +638,7 @@ class ContinuousBatcher:
                 # is the TARGET's (exactness requires it)
                 _dlg, d_rows = _prefill_cache(self.draft_model,
                                               self.draft_variables,
-                                              jnp.asarray(padded))
+                                              d_padded)
                 self._d_cache = self._load_many(self._d_cache, d_rows,
                                                 jnp.asarray(slots))
             if self.paged:

@@ -120,6 +120,17 @@ _METRIC_CALL = re.compile(
     r"(?:telemetry|core_telemetry)\s*\.\s*(?:incr|gauge|histogram)\s*\(\s*"
     r"(f?)(\"|')([^\"'\n]+)\2")
 
+# bare-name calls (`from ..core.telemetry import incr` style) slip past
+# the module-prefix pattern above, so files that import the recording
+# functions directly get a second scan.  The lookbehind keeps
+# `telemetry.incr(` from double-matching.
+_METRIC_CALL_BARE = re.compile(
+    r"(?<![\w.])(?:incr|gauge|histogram)\s*\(\s*"
+    r"(f?)(\"|')([^\"'\n]+)\2")
+_TELEMETRY_IMPORT = re.compile(
+    r"from\s+[\w.]*telemetry[\w.]*\s+import\s+[^\n]*"
+    r"\b(?:incr|gauge|histogram)\b")
+
 
 def _declared_metric_names():
     """DECLARED_METRICS keys parsed out of metrics.py's dict literal via
@@ -166,7 +177,10 @@ def metrics_lint() -> int:
             continue  # the registry's own sources/docstrings
         with open(path, encoding="utf-8") as f:
             src = f.read()
-        for m in _METRIC_CALL.finditer(src):
+        matches = list(_METRIC_CALL.finditer(src))
+        if _TELEMETRY_IMPORT.search(src):
+            matches.extend(_METRIC_CALL_BARE.finditer(src))
+        for m in matches:
             is_f, literal = m.group(1) == "f", m.group(3)
             name = literal.split("{", 1)[0] if is_f else literal
             if not resolves(name, dynamic_tail=is_f and "{" in literal):
