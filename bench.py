@@ -350,9 +350,18 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
                                output_col="features", batch_size=E2E_BATCH,
                                use_pallas=False)
         feat.transform(table)
+    from mmlspark_tpu.core import telemetry as core_telemetry
     from mmlspark_tpu.io.feed import FEED_TELEMETRY, FeedTelemetry
     from mmlspark_tpu.io.pipeline import PIPELINE_TELEMETRY
 
+    # warmup compiled every shape group above; from here to the end of
+    # the timed reps any XLA compile is a steady-state recompile — the
+    # sentry flags it and the count lands in the record (perf-gated at
+    # zero tolerance)
+    sentry = core_telemetry.track_compiles()
+    sentry.end_warmup()
+    hot_before = sum(
+        core_telemetry.counters("xla.compile.hot_path").values())
     feed_since = FEED_TELEMETRY.snapshot()
     pipe_since = PIPELINE_TELEMETRY.snapshot()
     reps = 3
@@ -364,6 +373,15 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
         e2e_dt = dt if e2e_dt is None else min(e2e_dt, dt)
     assert out_table["features"].shape[0] == e2e_n
     e2e_ips = e2e_n / e2e_dt
+    steady_recompiles = (sum(
+        core_telemetry.counters("xla.compile.hot_path").values())
+        - hot_before)
+    # back to warmup mode: the train/vit/lm measurements that follow
+    # legitimately compile their own programs
+    sentry.reset()
+    # HBM pressure + live buffers at peak working set (CPU CI reports
+    # only the buffer count; memory_stats-less backends no-op)
+    device_mem = core_telemetry.sample_device_memory()
     # the DeviceFeed engine's own counters over the timed transforms:
     # achieved wire bandwidth, the fraction of feed wall time hidden
     # under device compute, and the host-side stall budget — these are
@@ -394,8 +412,6 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     # the registry view of the same run: per-transfer latency tail off the
     # io.feed.transfer.latency histogram (summarize's counters are totals
     # only — the p95 is what catches a bimodal link)
-    from mmlspark_tpu.core import telemetry as core_telemetry
-
     obs = core_telemetry.export_snapshot(include_spans=False)
     feed_hist = obs["histograms"].get("io.feed.transfer.latency")
     feed_p95_ms = (round(feed_hist["p95"] * 1e3, 3)
@@ -410,7 +426,9 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
         "feed_gbps": feed["h2d_gbps"],
         "feed_transfer_calls": feed["transfer_calls"],
         "feed_transfer_p95_ms": feed_p95_ms,
+        "steady_recompiles": steady_recompiles,
         **{k: v for k, v in stage_ms.items() if v is not None},
+        **device_mem,
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
     }
@@ -487,10 +505,39 @@ def _child_measure():
                 lm["lm_attn_fallback"] = True
             except Exception as e2:  # noqa: BLE001
                 lm = {"lm_error": f"{str(e)[-120:]} | retry: {str(e2)[-120:]}"}
-    print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm}))
+    # the registry's own view of the run rides along so --obs-out saves
+    # a self-describing snapshot (meta: backend/devices/pid/timestamp)
+    from mmlspark_tpu.core import telemetry as core_telemetry
+
+    obs = core_telemetry.export_snapshot(
+        include_spans=False,
+        timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm,
+                      "obs": obs}))
+
+
+def _obs_out_path():
+    """--obs-out PATH from argv (bench predates argparse; flags are
+    membership tests)."""
+    argv = sys.argv
+    if "--obs-out" in argv:
+        i = argv.index("--obs-out")
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
+def _write_obs_out(path, record, obs):
+    """Snapshot file for tools/perf_gate.py: the bench record plus the
+    child's registry snapshot (None when the run degraded to stale)."""
+    if not path:
+        return
+    with open(path, "w") as f:
+        json.dump({"record": record, "obs": obs}, f)
 
 
 def main():
+    obs_path = _obs_out_path()
     if "--child-measure" in sys.argv:
         _child_measure()
         return
@@ -506,6 +553,12 @@ def main():
                        "note": "ImageFeaturizer e2e on host XLA-CPU, same "
                                "code/methodology as the chip run (feed batch "
                                f"{E2E_BATCH}, best-of-3)"}, f)
+        if obs_path:
+            from mmlspark_tpu.core import telemetry as core_telemetry
+            _write_obs_out(obs_path, res, core_telemetry.export_snapshot(
+                include_spans=False,
+                timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())))
         print(json.dumps(res))
         return
 
@@ -520,13 +573,17 @@ def main():
                 last = json.load(f)
             last["stale"] = True
             last["error"] = reason
+            _write_obs_out(obs_path, last, None)
             print(json.dumps(last))
         else:
-            print(json.dumps({
+            null_record = {
                 "metric": "resnet50_imagefeaturizer_images_per_sec_per_chip",
                 "value": None, "unit": "images/sec", "vs_baseline": None,
                 "error": reason + " and no cached measurement",
-            }))
+                "stale": True,
+            }
+            _write_obs_out(obs_path, null_record, None)
+            print(json.dumps(null_record))
 
     if not _probe_backend():
         # chip unreachable: report the last good measurement, marked stale
@@ -582,6 +639,8 @@ def main():
         **{k: res[k] for k in ("decode_ips", "h2d_gbps", "h2d_ips",
                                "overlap_frac", "stall_s", "feed_gbps",
                                "feed_transfer_calls", "feed_transfer_p95_ms",
+                               "steady_recompiles", "hbm_bytes_in_use",
+                               "hbm_peak_bytes", "live_buffer_count",
                                "decode_ms", "host_assemble_ms",
                                "h2d_ms", "forward_ms",
                                "e2e_bound", "bottleneck_error",
@@ -598,6 +657,8 @@ def main():
     if res["platform"] != "cpu":  # only chip runs count as "good"
         with open(LASTGOOD_FILE, "w") as f:
             json.dump(record, f)
+    # older child protocols (mocked in contract tests) carry no obs key
+    _write_obs_out(obs_path, record, child.get("obs"))
     print(json.dumps(record))
 
 

@@ -23,7 +23,12 @@ New surface (see docs/observability.md):
   registry; names follow ``layer.component.metric`` and must be
   declared in :data:`metrics.DECLARED_METRICS` (CI-linted).
 * exposition — ``render_prometheus()`` (``/metrics``),
-  ``export_snapshot()`` (bench / chaos_soak / obs_report).
+  ``export_snapshot()`` (bench / chaos_soak / obs_report),
+  ``render_chrome_trace()`` (``/trace.json`` → Perfetto).
+* device — ``track_compiles()`` / ``watch_compiles()`` (the XLA compile
+  sentry), ``sample_device_memory()`` / ``start_memory_sampler()`` (HBM
+  + live-buffer gauges), ``enable_device_annotations()`` (opt-in
+  ``jax.profiler.TraceAnnotation`` on stage spans).
 """
 from __future__ import annotations
 
@@ -58,7 +63,19 @@ from .exposition import (
     export_snapshot,
     format_latency_table,
     format_span_tree,
+    render_chrome_trace,
     render_prometheus,
+)
+from .device import (
+    SENTRY,
+    CompileSentry,
+    MemorySampler,
+    device_annotation,
+    enable_device_annotations,
+    sample_device_memory,
+    start_memory_sampler,
+    track_compiles,
+    watch_compiles,
 )
 
 __all__ = [
@@ -77,8 +94,12 @@ __all__ = [
     "current_trace_id", "trace_headers", "extract_trace", "get_trace",
     "span_tree", "recent_spans", "clear_spans",
     # exposition
-    "render_prometheus", "export_snapshot", "format_span_tree",
-    "format_latency_table",
+    "render_prometheus", "export_snapshot", "render_chrome_trace",
+    "format_span_tree", "format_latency_table",
+    # device (compile sentry, memory gauges, annotations)
+    "SENTRY", "CompileSentry", "track_compiles", "watch_compiles",
+    "sample_device_memory", "MemorySampler", "start_memory_sampler",
+    "enable_device_annotations", "device_annotation",
 ]
 
 

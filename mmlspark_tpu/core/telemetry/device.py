@@ -1,0 +1,434 @@
+"""Device-level observability: the XLA compile sentry, HBM memory
+gauges, and opt-in `jax.profiler` trace annotations.
+
+Everything host-side in this package watches OUR code; this module
+watches the runtime underneath it.  Three concerns:
+
+* **Compile sentry** — `track_compiles()` registers a `jax.monitoring`
+  event-duration listener (fires synchronously on the compiling thread)
+  that records every XLA compile as an `xla.compile` span — a child of
+  the active trace when one is open, so a serving request that triggered
+  a compile shows it in `/trace/<id>` — plus an `xla.compile.latency`
+  histogram observation and an `xla.compile.count` bump.  After the
+  caller DECLARES warmup over (`SENTRY.end_warmup()`), every further
+  compile is flagged as a steady-state recompile: `xla.compile.hot_path`
+  counter + WARNING log.  This jax version's monitoring events carry no
+  function/shape metadata, so naming the triggering shape is the job of
+  `watch_compiles(fn, name)`: a transparent wrapper around a jitted
+  callable that detects a compile during a call (`_cache_size()` delta,
+  falling back to the sentry's global compile count) and, in steady
+  state, emits a loud `log_verb` record + WARNING naming the argument
+  shapes that forced it (`float32[8,224,224,3]`), bumping the per-entry
+  `xla.compile.hot_path.<name>` family.
+
+* **Memory gauges** — `sample_device_memory()` folds
+  `device.memory_stats()` across local devices into
+  `device.hbm.bytes_in_use` / `device.hbm.peak_bytes` and counts
+  `client.live_buffers()` into `device.live_buffer_count`.  Backends
+  without memory_stats (CPU CI) skip the HBM gauges and keep the buffer
+  count — a graceful no-op, never an exception.  The sampler is PASSIVE:
+  if jax is not imported, or imported but its backend never initialized,
+  sampling returns {} rather than being the thing that grabs a device.
+  `start_memory_sampler(interval_s)` runs it on a daemon thread;
+  `ServingServer` best-effort samples on every `/metrics` scrape.
+
+* **Device annotations** — `enable_device_annotations()` arms the span
+  layer so `span()` additionally enters a `jax.profiler.TraceAnnotation`
+  for matching span names (`training.step`, `pipeline.<stage>`, ...),
+  and `device_annotation(name)` gives already-measured sites
+  (`feed._device_put`) the same opt-in wrapper.  Off by default: on real
+  hardware under a profiler capture the device timeline then carries our
+  span names.
+
+This module imports no jax at module scope — the telemetry package must
+stay importable (and `/metrics` servable) in processes that never touch
+a device.
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from . import spans as _spans
+from .metrics import REGISTRY
+from .records import log_verb, logger
+
+__all__ = ["CompileSentry", "SENTRY", "track_compiles", "watch_compiles",
+           "describe_abstract_shapes", "sample_device_memory",
+           "MemorySampler", "start_memory_sampler",
+           "enable_device_annotations", "device_annotation",
+           "DEFAULT_ANNOTATION_PREFIXES"]
+
+# the one monitoring event that means "XLA produced an executable";
+# jaxpr tracing / MLIR lowering durations ride the same listener but are
+# phases of the same compile, not separate compiles
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+
+def describe_abstract_shapes(args: Iterable[Any],
+                             kwargs: Optional[Dict[str, Any]] = None,
+                             limit: int = 8) -> str:
+    """'float32[8,224,224,3], int32[8]' for the array-like leaves among
+    a call's top-level arguments — the shape signature a recompile keys
+    on.  Non-array arguments (pytrees of params, static config) are
+    skipped: the data batch is what changes shape in practice."""
+    parts = []
+    values = list(args) + list((kwargs or {}).values())
+    for v in values:
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            dims = ",".join(str(int(d)) for d in shape)
+        except (TypeError, ValueError):
+            dims = str(shape)
+        parts.append(f"{dtype}[{dims}]")
+        if len(parts) >= limit:
+            parts.append("...")
+            break
+    return ", ".join(parts) if parts else "<no array args>"
+
+
+class CompileSentry:
+    """Process-wide compile watcher.  Starts in WARMUP: compiles are
+    recorded (span + histogram + count) but expected.  After
+    `end_warmup()` every compile is a steady-state recompile — the exact
+    hazard `tpu_model.pad_to_batch` exists to prevent — and is flagged
+    loudly.  `reset()` returns to warmup (tests, or a planned
+    reconfiguration that legitimately recompiles)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._installed = False
+        self._listener_active = False
+        self._steady = False
+        self._compiles = 0
+
+    # ---- state ---------------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Compiles seen by the monitoring listener (0 when unavailable)."""
+        with self._lock:
+            return self._compiles
+
+    @property
+    def listener_active(self) -> bool:
+        with self._lock:
+            return self._listener_active
+
+    @property
+    def in_warmup(self) -> bool:
+        with self._lock:
+            return not self._steady
+
+    def end_warmup(self) -> None:
+        """Declare warmup over: from here, any compile is a hot-path
+        recompile and gets flagged."""
+        with self._lock:
+            self._steady = True
+
+    def reset(self) -> None:
+        with self._lock:
+            self._steady = False
+
+    @contextlib.contextmanager
+    def warmup(self):
+        """Compiles inside the block are warmup; steady-state flagging
+        (re-)arms when it exits."""
+        with self._lock:
+            self._steady = False
+        try:
+            yield self
+        finally:
+            self.end_warmup()
+
+    # ---- installation --------------------------------------------------
+    def install(self) -> "CompileSentry":
+        """Idempotently register the jax.monitoring listener.  Without
+        jax (or without the monitoring API) the sentry still works in
+        wrapper-only mode: `watch_compiles` call sites detect compiles
+        via `_cache_size()` deltas."""
+        with self._lock:
+            if self._installed:
+                return self
+            self._installed = True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                self._on_event_duration)
+        except Exception:
+            return self
+        with self._lock:
+            self._listener_active = True
+        return self
+
+    def _on_event_duration(self, event: str, duration: float,
+                           **_kw: Any) -> None:
+        # fires synchronously on the thread running the compile, so
+        # current_context() attributes the span to the request/step that
+        # triggered it
+        if not event.endswith(_COMPILE_EVENT_SUFFIX):
+            return
+        with self._lock:
+            self._compiles += 1
+            steady = self._steady
+        phase = "steady" if steady else "warmup"
+        try:
+            REGISTRY.incr("xla.compile.count")
+            REGISTRY.histogram("xla.compile.latency").observe(float(duration))
+            _spans.record_span("xla.compile", _spans.current_context(),
+                               float(duration), phase=phase)
+            if steady:
+                REGISTRY.incr("xla.compile.hot_path")
+                logger.warning(
+                    "xla.compile.hot_path: steady-state XLA recompile "
+                    "(%.3fs backend compile) — a shape/dtype the warmup "
+                    "never saw reached a jitted function", duration)
+        except Exception:
+            # a telemetry listener must never break a compile
+            pass
+
+    # ---- wrapper-side reporting ----------------------------------------
+    def note_traced_compile(self, name: str, args: tuple,
+                            kwargs: Dict[str, Any]) -> None:
+        """A `watch_compiles` wrapper saw its function compile during a
+        call.  In warmup this is expected (the listener already counted
+        it); in steady state, name the triggering shape loudly."""
+        with self._lock:
+            steady = self._steady
+            listener = self._listener_active
+        if not steady:
+            return
+        shape = describe_abstract_shapes(args, kwargs)
+        REGISTRY.incr(f"xla.compile.hot_path.{name}")
+        if not listener:
+            # no monitoring API: the wrapper is the only counter
+            REGISTRY.incr("xla.compile.count")
+            REGISTRY.incr("xla.compile.hot_path")
+        with log_verb(self, "hot_path_recompile", fn=name, shape=shape):
+            pass
+        logger.warning(
+            "xla.compile.hot_path: %s recompiled in steady state for %s "
+            "— pad or bucket inputs so serving/training reuses the "
+            "warmed executable", name, shape)
+
+
+SENTRY = CompileSentry()
+
+
+def track_compiles() -> CompileSentry:
+    """Arm the process-wide compile sentry (idempotent) and return it.
+    Call once before warmup; call `.end_warmup()` when the shapes you
+    intend to serve/train have all compiled."""
+    return SENTRY.install()
+
+
+class _WatchedFunction:
+    """Transparent proxy over a jitted callable that reports compiles to
+    the sentry with shape attribution.  Attribute access (`.lower`,
+    `.clear_cache`, ...) passes through, so call sites that treat the
+    value as a PjitFunction keep working."""
+
+    __slots__ = ("_fn", "_name", "_sentry")
+
+    def __init__(self, fn, name: str, sentry: CompileSentry):
+        self._fn = fn
+        self._name = name
+        self._sentry = sentry
+
+    @property
+    def __wrapped__(self):
+        return self._fn
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+    def _marker(self) -> Tuple[str, int]:
+        cache_size = getattr(self._fn, "_cache_size", None)
+        if cache_size is not None:
+            try:
+                return ("cache", int(cache_size()))
+            except Exception:
+                pass
+        return ("global", self._sentry.compile_count)
+
+    def __call__(self, *args, **kwargs):
+        kind_before, before = self._marker()
+        out = self._fn(*args, **kwargs)
+        kind_after, after = self._marker()
+        if kind_after == kind_before and after > before:
+            self._sentry.note_traced_compile(self._name, args, kwargs)
+        return out
+
+    def __repr__(self) -> str:
+        return f"watch_compiles({self._fn!r}, name={self._name!r})"
+
+
+def watch_compiles(fn, name: str,
+                   sentry: Optional[CompileSentry] = None):
+    """Wrap a jitted callable so steady-state recompiles are attributed
+    to `name` and the triggering argument shapes.  Arms the sentry's
+    monitoring listener as a side effect (the wrapper and the listener
+    are two halves of one mechanism: the listener times and counts, the
+    wrapper names)."""
+    s = sentry if sentry is not None else SENTRY
+    s.install()
+    return _WatchedFunction(fn, name, s)
+
+
+# ---- memory gauges --------------------------------------------------------
+
+def _jax_if_initialized():
+    """The imported jax module, or None when jax is absent OR its
+    backend was never initialized — a metrics scrape must stay passive
+    and never be the call that claims a device."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+        backends = getattr(xla_bridge, "_backends", None)
+        if backends is not None and not backends:
+            return None
+    except Exception:
+        pass
+    return jax
+
+
+def sample_device_memory(devices=None) -> Dict[str, int]:
+    """One best-effort sample of device memory into the gauges.
+
+    Returns the sampled values ({} when jax/backend is unavailable):
+    `hbm_bytes_in_use` / `hbm_peak_bytes` summed across local devices
+    where the backend reports `memory_stats()` (TPU/GPU; CPU returns
+    None and the HBM gauges are simply not written), and
+    `live_buffer_count` from each client's `live_buffers()` (works on
+    every backend; falls back to `jax.live_arrays()`)."""
+    jax = _jax_if_initialized()
+    if jax is None:
+        return {}
+    try:
+        devs = list(devices) if devices is not None else jax.local_devices()
+    except Exception:
+        return {}
+    out: Dict[str, int] = {}
+    bytes_in_use = peak_bytes = 0
+    have_stats = False
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        have_stats = True
+        used = int(stats.get("bytes_in_use", 0))
+        bytes_in_use += used
+        peak_bytes += int(stats.get("peak_bytes_in_use", used))
+    if have_stats:
+        REGISTRY.gauge("device.hbm.bytes_in_use").set(bytes_in_use)
+        REGISTRY.gauge("device.hbm.peak_bytes").set(peak_bytes)
+        out["hbm_bytes_in_use"] = bytes_in_use
+        out["hbm_peak_bytes"] = peak_bytes
+    n_buffers: Optional[int] = None
+    try:
+        clients = {id(d.client): d.client for d in devs}
+        n_buffers = sum(len(c.live_buffers()) for c in clients.values())
+    except Exception:
+        try:
+            n_buffers = len(jax.live_arrays())
+        except Exception:
+            n_buffers = None
+    if n_buffers is not None:
+        REGISTRY.gauge("device.live_buffer_count").set(n_buffers)
+        out["live_buffer_count"] = n_buffers
+    return out
+
+
+class MemorySampler:
+    """Daemon thread sampling device memory every `interval_s`.  Also a
+    context manager: `with MemorySampler(5.0): ...`."""
+
+    def __init__(self, interval_s: float = 5.0, devices=None):
+        self.interval_s = float(interval_s)
+        self._devices = devices
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MemorySampler":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="device-memory-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sample_device_memory(self._devices)
+            except Exception:
+                pass
+            self._stop.wait(self.interval_s)
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+
+    def __enter__(self) -> "MemorySampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def start_memory_sampler(interval_s: float = 5.0,
+                         devices=None) -> MemorySampler:
+    return MemorySampler(interval_s, devices).start()
+
+
+# ---- device annotations ---------------------------------------------------
+
+# the stage spans worth seeing on a device timeline: the training step,
+# the h2d transfer, and the host-pipeline stages (recorded as
+# `pipeline.<stage>` spans and annotated as such)
+DEFAULT_ANNOTATION_PREFIXES: Tuple[str, ...] = (
+    "training.step", "feed.transfer", "io.pipeline", "pipeline.")
+
+
+def enable_device_annotations(
+        enabled: bool = True,
+        prefixes: Tuple[str, ...] = DEFAULT_ANNOTATION_PREFIXES) -> bool:
+    """Opt in (or out) of wrapping matching spans in
+    `jax.profiler.TraceAnnotation` so a real profiler capture shows our
+    span names on the device timeline.  Returns True when armed."""
+    if not enabled:
+        _spans.set_annotation_hook(None, ())
+        return False
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:
+        _spans.set_annotation_hook(None, ())
+        return False
+    _spans.set_annotation_hook(TraceAnnotation, tuple(prefixes))
+    return True
+
+
+def device_annotation(name: str):
+    """A TraceAnnotation context for `name` when annotations are armed
+    and the name matches, else a no-op context — for already-measured
+    sites (`feed._device_put`, pipeline workers) whose spans go through
+    `record_span` and so never pass through `span()`'s hook."""
+    factory, prefixes = _spans.get_annotation_hook()
+    if factory is None or not prefixes or not name.startswith(prefixes):
+        return contextlib.nullcontext()
+    try:
+        return factory(name)
+    except Exception:
+        return contextlib.nullcontext()

@@ -8,15 +8,23 @@ names (`serving.request.latency`) sanitize to underscore names
 """
 from __future__ import annotations
 
+import json
 import math
+import os
 import re
-from typing import Any, Dict, List, Optional, Tuple
+import sys
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from .metrics import REGISTRY, MetricsRegistry
 from . import spans as _spans
 
-__all__ = ["render_prometheus", "export_snapshot", "format_span_tree",
-           "format_latency_table", "sanitize_name"]
+__all__ = ["render_prometheus", "export_snapshot", "render_chrome_trace",
+           "format_span_tree", "format_latency_table", "sanitize_name"]
+
+# process uptime baseline: first telemetry import ≈ process start for
+# every consumer that records anything
+_T0_MONOTONIC = time.monotonic()
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -88,13 +96,59 @@ def _hist_key(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
     return name + "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
 
 
+def _json_safe(v: Any) -> Any:
+    """`v` if json can carry it, else its repr() — span attrs are
+    free-form and a stray ndarray/dtype must degrade to a string, not
+    crash a /metrics-adjacent dump."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError, OverflowError):
+        return repr(v)
+
+
+def _safe_span(rec: Dict[str, Any]) -> Dict[str, Any]:
+    attrs = rec.get("attrs")
+    if not attrs:
+        return rec
+    return dict(rec, attrs={k: _json_safe(v) for k, v in attrs.items()})
+
+
+def _snapshot_meta(timestamp: Optional[str]) -> Dict[str, Any]:
+    """Self-describing header for saved snapshots.  Backend facts are
+    reported only when jax is ALREADY imported — a /metrics-adjacent
+    dump must never be the thing that drags jax (and a device grab) into
+    the process."""
+    meta: Dict[str, Any] = {
+        "timestamp": timestamp,
+        "pid": os.getpid(),
+        "uptime_s": round(time.monotonic() - _T0_MONOTONIC, 3),
+        "backend": None,
+        "device_count": None,
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            meta["backend"] = jax.default_backend()
+            meta["device_count"] = jax.device_count()
+        except Exception:
+            pass
+    return meta
+
+
 def export_snapshot(registry: MetricsRegistry = REGISTRY,
-                    include_spans: bool = True) -> Dict[str, Any]:
+                    include_spans: bool = True,
+                    timestamp: Optional[str] = None) -> Dict[str, Any]:
     """One JSON-serializable dict of everything the process has
-    observed — counters, gauges, histogram snapshots (keyed
-    `name` or `name{k="v"}`), and (optionally) the recent-span ring.
-    `bench.py` and `tools/chaos_soak.py` report through this; saved to a
-    file it is what `tools/obs_report.py` renders."""
+    observed — a `meta` header (caller-supplied timestamp, pid, jax
+    backend + device count when jax is loaded, process uptime),
+    counters, gauges, histogram snapshots (keyed `name` or
+    `name{k="v"}`), and (optionally) the recent-span ring with
+    non-serializable attrs degraded to repr().  `bench.py` and
+    `tools/chaos_soak.py` report through this; saved to a file it is
+    what `tools/obs_report.py` renders."""
     hists: Dict[str, Any] = {}
     for (name, labels), h in registry.histograms().items():
         snap = h.snapshot()
@@ -104,13 +158,55 @@ def export_snapshot(registry: MetricsRegistry = REGISTRY,
         ]
         hists[_hist_key(name, labels)] = snap
     out: Dict[str, Any] = {
+        "meta": _snapshot_meta(timestamp),
         "counters": registry.counter_values(),
         "gauges": registry.gauge_values(),
         "histograms": hists,
     }
     if include_spans:
-        out["spans"] = _spans.recent_spans()
+        out["spans"] = [_safe_span(r) for r in _spans.recent_spans()]
     return out
+
+
+def render_chrome_trace(span_records: Optional[Iterable[Dict[str, Any]]]
+                        = None) -> Dict[str, Any]:
+    """The span ring as Chrome/Perfetto trace-event JSON — load the
+    dump in ui.perfetto.dev or chrome://tracing.
+
+    Each span becomes a `ph:"X"` complete event: ts/dur in microseconds
+    (trace-event convention), pid = this process, tid = the thread that
+    recorded the span, and trace/span/parent ids + attrs under `args` so
+    the causal tree survives into the viewer.  Served at `GET
+    /trace.json`; written by `tools/obs_report.py --chrome-out`."""
+    if span_records is None:
+        span_records = _spans.recent_spans()
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": f"mmlspark_tpu[{pid}]"},
+    }]
+    for rec in span_records:
+        name = str(rec.get("name", "?"))
+        args: Dict[str, Any] = {
+            "trace_id": rec.get("trace_id"),
+            "span_id": rec.get("span_id"),
+            "parent_id": rec.get("parent_id"),
+        }
+        for k, v in (rec.get("attrs") or {}).items():
+            args[k] = _json_safe(v)
+        if rec.get("error"):
+            args["error"] = rec["error"]
+        events.append({
+            "name": name,
+            "cat": name.split(".", 1)[0],
+            "ph": "X",
+            "ts": round(float(rec.get("t_start", 0.0)) * 1e6, 3),
+            "dur": round(max(0.0, float(rec.get("wall_s", 0.0))) * 1e6, 3),
+            "pid": pid,
+            "tid": int(rec.get("tid", 0)),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 # ---- obs_report renderers ------------------------------------------------

@@ -51,6 +51,8 @@ DECLARED_METRICS: Dict[str, str] = {
     "training.autosave": "counter",
     "training.resume": "counter",
     "io.pipeline.items": "counter",       # + .<stage> variants
+    "xla.compile.count": "counter",       # every observed XLA compile
+    "xla.compile.hot_path": "counter",    # + .<fn> variants: steady-state
     # -- histograms
     "serving.request.latency": "histogram",
     "serving.batch.fill": "histogram",
@@ -60,6 +62,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.pipeline.stage.latency": "histogram",   # labeled {stage=...}
     "io.http.request.latency": "histogram",
     "models.training.step_latency": "histogram",
+    "xla.compile.latency": "histogram",
     # -- gauges
     "serving.queue.depth": "gauge",
     "serving.batcher.queue_depth": "gauge",
@@ -68,6 +71,9 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.feed.stall_s": "gauge",
     "io.pipeline.queue.depth": "gauge",   # + .<stage> variants
     "models.training.examples_per_sec": "gauge",
+    "device.hbm.bytes_in_use": "gauge",
+    "device.hbm.peak_bytes": "gauge",
+    "device.live_buffer_count": "gauge",
 }
 
 

@@ -47,7 +47,10 @@ def clear_records():
 
 
 @contextlib.contextmanager
-def log_verb(stage, method: str):
+def log_verb(stage, method: str, **extra):
+    """Extra keyword fields are merged into the record verbatim (callers
+    pass JSON-safe values — e.g. the compile sentry naming a triggering
+    shape); they never override the core fields."""
     t0 = time.perf_counter()
     err = None
     try:
@@ -56,13 +59,14 @@ def log_verb(stage, method: str):
         err = type(e).__name__
         raise
     finally:
-        rec = {
+        rec = dict(extra)
+        rec.update({
             "uid": getattr(stage, "uid", "?"),
             "className": type(stage).__name__,
             "method": method,
             "buildVersion": version.__version__,
             "wallTimeSec": round(time.perf_counter() - t0, 6),
-        }
+        })
         if err:
             rec["error"] = err
         with _RECORDS_LOCK:

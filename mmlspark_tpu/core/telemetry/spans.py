@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = ["span", "record_span", "use_trace", "current_context",
            "current_trace_id", "trace_headers", "extract_trace",
            "get_trace", "span_tree", "recent_spans", "clear_spans",
+           "set_annotation_hook", "get_annotation_hook",
            "MAX_SPANS", "MAX_TRACES", "MAX_SPANS_PER_TRACE"]
 
 MAX_SPANS = 8192          # global recent-span ring
@@ -49,6 +50,39 @@ _TRACES: "collections.OrderedDict[str, List[Dict[str, Any]]]" = \
 
 def _new_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+# Optional device-annotation hook (set by telemetry.device): a factory of
+# context managers (jax.profiler.TraceAnnotation) plus the span-name
+# prefixes it applies to.  When armed, span() additionally enters an
+# annotation for matching names so the device timeline in a real
+# profiler capture carries our span names.  Kept here (not in device.py)
+# so span() stays jax-import-free: the factory is injected, never looked
+# up.
+_ANNOTATION_FACTORY = None
+_ANNOTATION_PREFIXES: Tuple[str, ...] = ()
+
+
+def set_annotation_hook(factory, prefixes: Tuple[str, ...] = ()) -> None:
+    """Arm (or with factory=None disarm) the device-annotation hook."""
+    global _ANNOTATION_FACTORY, _ANNOTATION_PREFIXES
+    _ANNOTATION_FACTORY = factory
+    _ANNOTATION_PREFIXES = tuple(prefixes)
+
+
+def get_annotation_hook():
+    return _ANNOTATION_FACTORY, _ANNOTATION_PREFIXES
+
+
+def _annotation_for(name: str):
+    if _ANNOTATION_FACTORY is None or not _ANNOTATION_PREFIXES:
+        return None
+    if not name.startswith(_ANNOTATION_PREFIXES):
+        return None
+    try:
+        return _ANNOTATION_FACTORY(name)
+    except Exception:
+        return None
 
 
 def current_context() -> Optional[Tuple[str, str]]:
@@ -102,11 +136,16 @@ def span(name: str, parent_ctx: Optional[Tuple[str, str]] = None,
     sp = _Span(name, trace_id, span_id,
                parent[1] if parent else None, dict(attrs))
     token = _CTX.set((trace_id, span_id))
+    annotation = _annotation_for(name)
     t_start = time.time()
     t0 = time.perf_counter()
     err: Optional[str] = None
     try:
-        yield sp
+        if annotation is not None:
+            with annotation:
+                yield sp
+        else:
+            yield sp
     except BaseException as e:  # noqa: BLE001 — recorded, then re-raised
         err = type(e).__name__
         raise
@@ -119,6 +158,7 @@ def span(name: str, parent_ctx: Optional[Tuple[str, str]] = None,
             "parent_id": sp.parent_id,
             "t_start": t_start,
             "wall_s": round(time.perf_counter() - t0, 6),
+            "tid": threading.get_ident(),
         }
         if err:
             rec["error"] = err
@@ -127,19 +167,22 @@ def span(name: str, parent_ctx: Optional[Tuple[str, str]] = None,
         _store(rec)
 
 
-def record_span(name: str, ctx: Tuple[str, str], wall_s: float,
+def record_span(name: str, ctx: Optional[Tuple[str, str]], wall_s: float,
                 **attrs: Any) -> Dict[str, Any]:
     """Record an already-measured span as a child of `ctx` — the
     cross-thread shape (a batch loop attributing queue wait to the
     handler thread's request span) where a context manager can't wrap
-    the producer."""
+    the producer.  With ctx=None the span roots a fresh trace (the
+    compile sentry recording an XLA compile that fired outside any
+    request)."""
     rec: Dict[str, Any] = {
         "name": name,
-        "trace_id": ctx[0],
+        "trace_id": ctx[0] if ctx is not None else _new_id(),
         "span_id": _new_id(),
-        "parent_id": ctx[1],
+        "parent_id": ctx[1] if ctx is not None else None,
         "t_start": time.time() - wall_s,
         "wall_s": round(float(wall_s), 6),
+        "tid": threading.get_ident(),
     }
     if attrs:
         rec["attrs"] = dict(attrs)

@@ -296,8 +296,13 @@ class DeviceFeed:
         for attempt in range(self.transfer_retries):
             try:
                 fault_point("feed.device_put")
-                return (jax.device_put(arr, sharding) if sharding is not None
-                        else jax.device_put(arr))
+                # no-op unless enable_device_annotations() armed the
+                # profiler hook: the transfer span itself is recorded
+                # after the fact via record_span, which can't annotate
+                with core_telemetry.device_annotation("feed.transfer"):
+                    return (jax.device_put(arr, sharding)
+                            if sharding is not None
+                            else jax.device_put(arr))
             except Exception as e:  # noqa: BLE001 — retried, then raised
                 last = e
                 if attempt == self.transfer_retries - 1:

@@ -294,7 +294,12 @@ class HostPipeline:
             seq, value = item
             t0 = time.perf_counter()
             try:
-                out = stage.fn(value)
+                # profiler annotation only when armed via
+                # enable_device_annotations() — same name as the
+                # record_span below so timelines and traces line up
+                with core_telemetry.device_annotation(
+                        f"pipeline.{stage.name}"):
+                    out = stage.fn(value)
             except BaseException as e:  # noqa: BLE001 — forwarded
                 self._fail(e)
                 return

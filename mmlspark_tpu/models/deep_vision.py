@@ -195,11 +195,12 @@ class DeepVisionClassifier(Estimator):
 
                 return jax.lax.scan(body, state, (images_s, labels_s))
 
-            epoch = jax.jit(
+            from ..core import telemetry as core_telemetry
+            epoch = core_telemetry.watch_compiles(jax.jit(
                 epoch_fn,
                 in_shardings=(None, NamedSharding(mesh, P(None, "data")),
                               NamedSharding(mesh, P(None, "data"))),
-                donate_argnums=(0,))
+                donate_argnums=(0,)), name="deep_vision.epoch")
             sh = NamedSharding(mesh, P(None, "data"))
             from ..io.feed import DeviceFeed
 

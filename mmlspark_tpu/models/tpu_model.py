@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import telemetry as core_telemetry
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
 from ..core.registry import register_stage
@@ -218,7 +219,10 @@ class TPUModel(Transformer):
                 )
             return taps[fetch].astype(jnp.float32)
 
-        jitted = jax.jit(forward)
+        # the compile sentry wrapper flags steady-state recompiles (the
+        # pad_to_batch hazard) and names the shape that forced them
+        jitted = core_telemetry.watch_compiles(
+            jax.jit(forward), name="tpu_model.forward")
         _EXEC_CACHE[key] = (dev_vars, jitted, mesh)
         while len(_EXEC_CACHE) > _EXEC_CACHE_MAX:
             _EXEC_CACHE.popitem(last=False)

@@ -134,11 +134,11 @@ def make_train_step(
     step = _step_body(model, optimizer, num_classes, seed)
     img_sh = batch_sharding(mesh, 4)
     lbl_sh = batch_sharding(mesh, 1)
-    return jax.jit(
+    return core_telemetry.watch_compiles(jax.jit(
         step,
         in_shardings=(None, img_sh, lbl_sh),
         donate_argnums=(0,) if donate else (),
-    )
+    ), name="training.train_step")
 
 
 def make_train_epoch(
@@ -168,11 +168,11 @@ def make_train_epoch(
 
     img_sh = NamedSharding(mesh, P(None, "data"))
     lbl_sh = NamedSharding(mesh, P(None, "data"))
-    return jax.jit(
+    return core_telemetry.watch_compiles(jax.jit(
         epoch,
         in_shardings=(None, img_sh, lbl_sh),
         donate_argnums=(0,) if donate else (),
-    )
+    ), name="training.train_epoch")
 
 
 def make_lm_train_epoch(
@@ -222,11 +222,11 @@ def make_lm_train_epoch(
         return params, opt_state, losses
 
     tok_sh = NamedSharding(mesh, P(None, "data"))
-    return jax.jit(
+    return core_telemetry.watch_compiles(jax.jit(
         epoch,
         in_shardings=(None, None, tok_sh),
         donate_argnums=(0, 1) if donate else (),
-    )
+    ), name="training.lm_train_epoch")
 
 
 def make_eval_step(model, mesh: Optional[Mesh] = None):
@@ -236,7 +236,9 @@ def make_eval_step(model, mesh: Optional[Mesh] = None):
         logits, _ = model.apply(variables, images, train=False)
         return jnp.argmax(logits, -1)
 
-    return jax.jit(step, in_shardings=(None, batch_sharding(mesh, 4)))
+    return core_telemetry.watch_compiles(
+        jax.jit(step, in_shardings=(None, batch_sharding(mesh, 4))),
+        name="training.eval_step")
 
 
 def init_train_state(model, optimizer, input_shape, seed: int = 0) -> TrainState:
@@ -324,11 +326,15 @@ def fit_epochs(
             for dbi, dbl in feed.stream(pipe.run(bounds),
                                         shardings=(img_sh, img_sh)):
                 t0 = time.perf_counter()
-                state, ms = epoch_fn(state, dbi, dbl)
-                # one scanned dispatch = len(dbi) optimizer steps; block
-                # on the metrics so the timing covers the device work,
-                # not just async dispatch
-                jax.block_until_ready(ms)
+                # the training.step span doubles as the device-timeline
+                # annotation hook when enable_device_annotations() is on
+                with core_telemetry.span("training.step") as _sp:
+                    state, ms = epoch_fn(state, dbi, dbl)
+                    # one scanned dispatch = len(dbi) optimizer steps;
+                    # block on the metrics so the timing covers the
+                    # device work, not just async dispatch
+                    jax.block_until_ready(ms)
+                    _sp.attrs["steps"] = int(dbi.shape[0])
                 dt = time.perf_counter() - t0
                 k_real = max(1, int(dbi.shape[0]))
                 core_telemetry.histogram(
@@ -347,10 +353,12 @@ def fit_epochs(
                 batches, shardings=(batch_sharding(mesh, 4),
                                     batch_sharding(mesh, 1))):
             t0 = time.perf_counter()
-            state, m = step_fn(state, dbi, dbl)
-            # the float() pulls block on the step's device work, so the
-            # measured wall is the true per-step cost, not dispatch
-            metrics = {k: float(v) for k, v in m.items()}
+            with core_telemetry.span("training.step"):
+                state, m = step_fn(state, dbi, dbl)
+                # the float() pulls block on the step's device work, so
+                # the measured wall is the true per-step cost, not
+                # dispatch
+                metrics = {k: float(v) for k, v in m.items()}
             dt = time.perf_counter() - t0
             core_telemetry.histogram(
                 "models.training.step_latency").observe(dt)
@@ -440,8 +448,9 @@ def fit_epochs_resumable(
             dbi, dbl = feed.put_group([images[idx], labels[idx]],
                                       shardings=(img_sh, lbl_sh))
             t0 = time.perf_counter()
-            state, m = step_fn(state, dbi, dbl)
-            metrics = {k: float(v) for k, v in m.items()}
+            with core_telemetry.span("training.step"):
+                state, m = step_fn(state, dbi, dbl)
+                metrics = {k: float(v) for k, v in m.items()}
             dt = time.perf_counter() - t0
             core_telemetry.histogram(
                 "models.training.step_latency").observe(dt)
@@ -514,8 +523,8 @@ def make_distill_epoch(
         return params, opt_state, losses
 
     tok_sh = NamedSharding(mesh, P(None, "data"))
-    return jax.jit(
+    return core_telemetry.watch_compiles(jax.jit(
         epoch,
         in_shardings=(None, None, tok_sh),
         donate_argnums=(0, 1) if donate else (),
-    )
+    ), name="training.distill_epoch")

@@ -140,7 +140,10 @@ class _BatchedImageStage(Transformer):
         token = repr(sorted(self.simple_param_values().items()))
         cached = self.__dict__.get("_jitted_pipeline")
         if cached is None or cached[0] != token:
-            cached = (token, jax.jit(self._pipeline_fn()))
+            from ..core import telemetry as core_telemetry
+            cached = (token, core_telemetry.watch_compiles(
+                jax.jit(self._pipeline_fn()),
+                name=f"image_stages.{type(self).__name__}"))
             self.__dict__["_jitted_pipeline"] = cached
         return np.asarray(cached[1](jnp.asarray(batch)))
 

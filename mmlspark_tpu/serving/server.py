@@ -240,15 +240,28 @@ class WorkerServer:
             def do_GET(self):
                 """Observability endpoints on every worker server:
                 `/metrics` (Prometheus text exposition of the process
-                registry) and `/trace/<id>` (one trace's spans + nested
-                tree as JSON)."""
+                registry), `/trace/<id>` (one trace's spans + nested
+                tree as JSON) and `/trace.json` (the whole span ring as
+                Chrome/Perfetto trace-event JSON)."""
                 path = self.path.split("?", 1)[0]
                 if path.rstrip("/") == "/metrics":
+                    try:
+                        # freshen the device gauges on every scrape;
+                        # passive no-op when jax/backend is absent
+                        telemetry.sample_device_memory()
+                    except Exception:
+                        pass
                     payload = telemetry.render_prometheus().encode("utf-8")
                     self._reply_bytes(
                         200, payload,
                         {"Content-Type":
                          "text/plain; version=0.0.4; charset=utf-8"})
+                    return
+                if path.rstrip("/") == "/trace.json":
+                    payload = json.dumps(
+                        telemetry.render_chrome_trace()).encode("utf-8")
+                    self._reply_bytes(200, payload,
+                                      {"Content-Type": "application/json"})
                     return
                 if path.startswith("/trace/"):
                     tid = path[len("/trace/"):].strip("/")

@@ -18,6 +18,7 @@ import pytest
 from PIL import Image
 
 from mmlspark_tpu import Table
+from mmlspark_tpu.io.feed import FEED_END, FeedSource
 from mmlspark_tpu.models.bundle import FlaxBundle
 from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
 from mmlspark_tpu import native
@@ -67,13 +68,28 @@ def test_mixed_shape_groups_share_one_feed_window(monkeypatch):
     chunk_shapes = set()  # source shapes of the chunks that flowed through
     orig = TPUModel.run_chunk_iter
 
+    def record(item):
+        if item is not FEED_END:
+            padded, _n = item
+            chunk_shapes.add(tuple(padded.shape[1:]))
+        return item
+
     def counted(self, chunk_iter, jitted, dev_vars, mesh):
+        windows.append(1)
+        if isinstance(chunk_iter, FeedSource):
+            # the streaming path hands a pipeline-backed FeedSource, not
+            # an iterable: tap its pull methods instead
+            orig_get = chunk_iter.get
+            orig_get_nowait = chunk_iter.get_nowait
+            chunk_iter.get = lambda: record(orig_get())
+            chunk_iter.get_nowait = lambda: record(orig_get_nowait())
+            return orig(self, chunk_iter, jitted, dev_vars, mesh)
+
         def spy():
             for padded, n in chunk_iter:
                 chunk_shapes.add(tuple(padded.shape[1:]))
                 yield padded, n
 
-        windows.append(1)
         return orig(self, spy(), jitted, dev_vars, mesh)
 
     monkeypatch.setattr(TPUModel, "run_chunk_iter", counted)

@@ -208,12 +208,16 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
 def write_obs_snapshot(path) -> str:
     """Dump the full observability snapshot (counters, gauges, histogram
     buckets, AND the recent-span ring) to `path` — the input format
-    tools/obs_report.py renders."""
+    tools/obs_report.py renders.  The meta timestamp makes the saved
+    file self-describing (which soak, which process, which backend)."""
+    import time
+
     from mmlspark_tpu.core import telemetry
 
     p = Path(path)
-    p.write_text(json.dumps(telemetry.export_snapshot(), indent=2,
-                            sort_keys=True))
+    snap = telemetry.export_snapshot(
+        timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    p.write_text(json.dumps(snap, indent=2, sort_keys=True))
     return str(p)
 
 

@@ -7,6 +7,8 @@ anywhere:
 
     python tools/ci.py lint                 # style gate + metrics lint
     python tools/ci.py metrics-lint         # declared-metric-name check only
+    python tools/ci.py perf-gate --fresh /tmp/bench_obs.json
+                                            # bench regression gate
     python tools/ci.py test [--shards N] [--shard K] [--retries R]
     python tools/ci.py all                  # lint + every shard
 
@@ -154,12 +156,42 @@ def _declared_metric_names():
     raise RuntimeError(f"DECLARED_METRICS dict literal not found in {path}")
 
 
+# Prometheus-name sanitization, kept in lockstep with
+# telemetry.exposition.sanitize_name (replicated here because importing
+# mmlspark_tpu would pull jax into every lint; parity is pinned by
+# tests/test_device_obs.py)
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize_metric_name(name: str) -> str:
+    out = _PROM_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
 def metrics_lint() -> int:
     """Grep instrumented metric/counter names across the tree and fail
     on any absent from DECLARED_METRICS (exact, or as a declared prefix
     for dynamic families like `circuit.open.<host>`; an f-string's
-    dynamic tail is checked by its literal prefix)."""
+    dynamic tail is checked by its literal prefix).  Also fails when two
+    DECLARED names sanitize to the same Prometheus name — two dotted
+    names colliding post-sanitization would silently merge into one
+    scraped series."""
     declared = _declared_metric_names()
+
+    collisions = 0
+    by_prom: dict = {}
+    for name in sorted(declared):
+        pn = _sanitize_metric_name(name)
+        other = by_prom.get(pn)
+        if other is not None:
+            print(f"mmlspark_tpu/core/telemetry/metrics.py: M002 declared "
+                  f"metrics {other!r} and {name!r} both sanitize to "
+                  f"Prometheus name {pn!r}")
+            collisions += 1
+        else:
+            by_prom[pn] = name
 
     def resolves(name: str, dynamic_tail: bool) -> bool:
         if name in declared:
@@ -189,10 +221,13 @@ def metrics_lint() -> int:
                       f"metric {name!r} not in DECLARED_METRICS "
                       f"(mmlspark_tpu/core/telemetry/metrics.py)")
                 failures += 1
+    failures += collisions
     if failures:
-        print(f"metrics-lint: {failures} undeclared metric name(s)")
+        print(f"metrics-lint: {failures} problem(s) "
+              f"({collisions} sanitize collision(s))")
     else:
-        print("metrics-lint: all instrumented names declared")
+        print("metrics-lint: all instrumented names declared, "
+              "no sanitize collisions")
     return 1 if failures else 0
 
 
@@ -263,21 +298,44 @@ def test(n_shards: int, shard: int, retries: int, timeout_s: int) -> int:
     return 0 if ok else 1
 
 
+def perf_gate(fresh: str, against: str = None, scale: float = 1.0) -> int:
+    """Delegate to tools/perf_gate.py (bench-record regression gate)."""
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from tools import perf_gate as gate
+    argv = [fresh, "--scale", str(scale)]
+    if against:
+        argv += ["--against", against]
+    return gate.main(argv)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("command", choices=["lint", "metrics-lint", "test",
-                                        "all"])
+                                        "perf-gate", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
     ap.add_argument("--retries", type=int, default=1)
     ap.add_argument("--timeout", type=int, default=1200,
                     help="per-shard budget, seconds (pipeline.yaml's 20min)")
+    ap.add_argument("--fresh", default=None,
+                    help="perf-gate: fresh bench snapshot "
+                         "(bench.py --obs-out file)")
+    ap.add_argument("--against", default=None,
+                    help="perf-gate: baseline record "
+                         "(default BENCH_LASTGOOD.json)")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="perf-gate: widen tolerance bands")
     args = ap.parse_args(argv)
     if args.command == "lint":
         return lint()
     if args.command == "metrics-lint":
         return metrics_lint()
+    if args.command == "perf-gate":
+        if not args.fresh:
+            ap.error("perf-gate requires --fresh SNAPSHOT")
+        return perf_gate(args.fresh, args.against, args.scale)
     if args.command == "test":
         return test(args.shards, args.shard, args.retries, args.timeout)
     rc = lint()

@@ -8,6 +8,8 @@ p50/p95/p99 latency table for every histogram in the registry.
 
 Usage:
     python tools/obs_report.py SNAPSHOT.json [--trace TRACE_ID] [--top N]
+    python tools/obs_report.py SNAPSHOT.json --chrome-out TRACE.json
+                                        # Perfetto/chrome://tracing dump
     python tools/obs_report.py --demo   # tiny in-process serving round-trip
 
 Also importable (tests/test_observability.py): `render_report(snapshot)`
@@ -46,6 +48,12 @@ def render_report(snapshot: Dict[str, Any], trace_id: Optional[str] = None,
                                              format_span_tree)
 
     lines: List[str] = []
+    meta = snapshot.get("meta")
+    if meta:
+        lines.append("== snapshot meta ==")
+        for k in sorted(meta):
+            lines.append(f"  {k} = {meta[k]}")
+        lines.append("")
     hists = snapshot.get("histograms", {})
     if hists:
         lines.append("== latency table (seconds unless the name says "
@@ -130,6 +138,9 @@ def main(argv=None) -> int:
                     help="how many (largest) traces to render")
     ap.add_argument("--demo", action="store_true",
                     help="run a tiny live serving round-trip and report it")
+    ap.add_argument("--chrome-out", default=None, metavar="FILE",
+                    help="also write the snapshot's spans as "
+                         "Chrome/Perfetto trace-event JSON")
     args = ap.parse_args(argv)
     if args.demo:
         snapshot = _demo_snapshot()
@@ -137,6 +148,14 @@ def main(argv=None) -> int:
         snapshot = json.loads(Path(args.snapshot).read_text())
     else:
         ap.error("need a SNAPSHOT.json or --demo")
+    if args.chrome_out:
+        from mmlspark_tpu.core.telemetry import render_chrome_trace
+
+        doc = render_chrome_trace(snapshot.get("spans", []))
+        Path(args.chrome_out).write_text(json.dumps(doc))
+        n = len(doc["traceEvents"]) - 1  # minus the process_name record
+        print(f"chrome trace: {n} events -> {args.chrome_out} "
+              f"(open in ui.perfetto.dev or chrome://tracing)")
     print(render_report(snapshot, trace_id=args.trace, top=args.top))
     return 0
 

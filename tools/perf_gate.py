@@ -1,0 +1,172 @@
+"""Perf regression gate: diff a fresh bench snapshot against the last
+known-good record with per-metric tolerance bands.
+
+    python bench.py --obs-out /tmp/bench_obs.json   # fresh snapshot
+    python tools/perf_gate.py /tmp/bench_obs.json   # vs BENCH_LASTGOOD.json
+    python tools/ci.py perf-gate --fresh /tmp/bench_obs.json
+
+Inputs accept either a bare bench record (the BENCH_LASTGOOD.json shape)
+or the `--obs-out` wrapper `{"record": ..., "obs": ...}`.  Every metric
+both sides carry is compared against its band from GATE_METRICS —
+direction-aware (throughput falls / latency rises = regression) with a
+relative tolerance sized to each metric's observed run-to-run noise,
+plus an absolute floor so near-zero counters don't trip on dust.  The
+delta table prints for every run; the exit code is the contract: 0 clean
+(or skipped: stale/infra-degraded snapshot, no overlapping metrics),
+1 on any regression outside its band.
+
+Metrics only ONE side carries are reported as untracked, never failed —
+the gate must stay green across PRs that add new bench fields.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(ROOT, "BENCH_LASTGOOD.json")
+
+# metric -> (direction, relative tolerance, absolute floor).
+# direction "higher": regression when fresh < base * (1 - rel) - abs;
+# direction "lower":  regression when fresh > base * (1 + rel) + abs.
+# Bands are sized to observed run-to-run noise: compute throughputs are
+# stable (10%), host-side decode and h2d numbers swing with machine load
+# (20-25%), stall/percentile tails are the noisiest (50%).
+GATE_METRICS: Dict[str, Tuple[str, float, float]] = {
+    "value": ("higher", 0.10, 0.0),
+    "forward_ips": ("higher", 0.10, 0.0),
+    "mfu": ("higher", 0.10, 0.0),
+    "cifar10_train_samples_per_sec": ("higher", 0.15, 0.0),
+    "vit_ips": ("higher", 0.10, 0.0),
+    "vit_mfu": ("higher", 0.10, 0.0),
+    "lm_tokens_per_sec": ("higher", 0.10, 0.0),
+    "lm_train_mfu": ("higher", 0.10, 0.0),
+    "decode_ips": ("higher", 0.20, 0.0),
+    "h2d_gbps": ("higher", 0.25, 0.0),
+    "h2d_ips": ("higher", 0.25, 0.0),
+    "feed_gbps": ("higher", 0.25, 0.0),
+    "overlap_frac": ("higher", 0.20, 0.05),
+    "stall_s": ("lower", 0.50, 0.05),
+    "feed_transfer_p95_ms": ("lower", 0.50, 0.5),
+    "feed_transfer_calls": ("lower", 0.25, 2.0),
+    # any steady-state recompile the warmed bench run never had is a bug
+    "steady_recompiles": ("lower", 0.0, 0.0),
+}
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    """The bench record from `path` — bare, or under an `--obs-out`
+    wrapper's "record" key."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("record"), dict):
+        return doc["record"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object bench record")
+    return doc
+
+
+def _numeric(v: Any) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def compare(fresh: Dict[str, Any], base: Dict[str, Any],
+            scale: float = 1.0) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Rows for every gated metric both records carry, plus the names
+    present on only one side.  `scale` widens every relative band
+    (--scale 2 for a known-noisy machine)."""
+    rows: List[Dict[str, Any]] = []
+    untracked: List[str] = []
+    for name, (direction, rel, floor) in GATE_METRICS.items():
+        f, b = _numeric(fresh.get(name)), _numeric(base.get(name))
+        if f is None or b is None:
+            if (name in fresh) != (name in base):
+                untracked.append(name)
+            continue
+        band = abs(b) * rel * scale + floor
+        if direction == "higher":
+            worse_by = b - f
+        else:
+            worse_by = f - b
+        delta_pct = ((f - b) / b * 100.0) if b else None
+        rows.append({
+            "metric": name,
+            "direction": direction,
+            "base": b,
+            "fresh": f,
+            "delta_pct": delta_pct,
+            "band": band,
+            "regressed": worse_by > band,
+        })
+    return rows, untracked
+
+
+def format_table(rows: List[Dict[str, Any]]) -> str:
+    header = ("metric", "dir", "lastgood", "fresh", "delta", "verdict")
+    out = [header]
+    for r in rows:
+        delta = ("n/a" if r["delta_pct"] is None
+                 else f"{r['delta_pct']:+.1f}%")
+        verdict = "REGRESSED" if r["regressed"] else "ok"
+        out.append((r["metric"], r["direction"][0].upper(),
+                    f"{r['base']:.6g}", f"{r['fresh']:.6g}", delta, verdict))
+    widths = [max(len(row[c]) for row in out) for c in range(len(header))]
+    lines = []
+    for i, row in enumerate(out):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths))
+                     .rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("fresh", help="fresh snapshot (bench record or "
+                                  "bench.py --obs-out file)")
+    ap.add_argument("--against", default=DEFAULT_BASELINE,
+                    help="baseline record (default: BENCH_LASTGOOD.json)")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="widen every relative tolerance band by this "
+                         "factor (noisy machines)")
+    args = ap.parse_args(argv)
+
+    fresh = load_record(args.fresh)
+    base = load_record(args.against)
+
+    # a stale record means bench fell back to the last-good numbers (an
+    # infra failure, not a measurement) — diffing it against itself
+    # proves nothing, so skip rather than rubber-stamp
+    if fresh.get("stale"):
+        print(f"perf-gate: SKIP — fresh snapshot is stale "
+              f"({fresh.get('stale_reason', 'bench fallback')}); "
+              f"no measurement to gate")
+        return 0
+
+    rows, untracked = compare(fresh, base, scale=args.scale)
+    if not rows:
+        print("perf-gate: SKIP — no gated metric present in both records")
+        return 0
+    print(f"perf-gate: {os.path.basename(args.fresh)} vs "
+          f"{os.path.basename(args.against)} (scale x{args.scale:g})")
+    print(format_table(rows))
+    for name in untracked:
+        print(f"perf-gate: note — {name!r} present on only one side "
+              f"(untracked, not gated)")
+    regressed = [r for r in rows if r["regressed"]]
+    if regressed:
+        names = ", ".join(r["metric"] for r in regressed)
+        print(f"perf-gate: FAIL — {len(regressed)} metric(s) outside "
+              f"tolerance: {names}")
+        return 1
+    print(f"perf-gate: OK — {len(rows)} metric(s) within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
