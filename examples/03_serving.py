@@ -2,7 +2,9 @@
 
 Train a small model, serve it with continuous batching, POST to it, and
 show the distributed multi-replica variant with service discovery
-(the reference's "Spark Serving" quickstart, docs/mmlspark-serving.md).
+(the reference's "Spark Serving" quickstart, docs/mmlspark-serving.md)
+fronted by the fleet gateway — one URL, registry-discovered replicas,
+balanced routing (docs/serving.md).
 
 Run: python examples/03_serving.py
 """
@@ -26,7 +28,8 @@ import numpy as np
 
 from mmlspark_tpu import Table
 from mmlspark_tpu.models.linear import LogisticRegression
-from mmlspark_tpu.serving import DistributedServingServer, list_services, read_stream
+from mmlspark_tpu.serving import (DistributedServingServer, FleetGateway,
+                                  list_services, read_stream)
 
 
 def post(url, payload):
@@ -63,21 +66,31 @@ def main():
     finally:
         query.stop()
 
-    # distributed: 2 replicas + discovery registry
+    # distributed: 2 replicas + discovery registry, fronted by the fleet
+    # gateway — clients see ONE url; the gateway discovers the replicas
+    # from the registry and balances across them (docs/serving.md)
     from mmlspark_tpu.core.pipeline import LambdaTransformer
 
     dist = DistributedServingServer(
         model=LambdaTransformer(score), reply_col="prediction",
         name="scorer-fleet", path="/score", replicas=2)
     infos = dist.start()
+    gw = FleetGateway(name="scorer-fleet", path="/score",
+                      registry_url=dist.registry.url)
     try:
         print("replicas:", [i.url for i in infos])
         print("discovered:", len(list_services(dist.registry.url,
                                                "scorer-fleet")))
-        for i, info in enumerate(infos):
-            print(f"replica {i} ->",
-                  post(info.url, {"f0": -2.0, "f1": 1.0, "f2": 0.0}))
+        gw_info = gw.start()
+        print("gateway:", gw_info.url)
+        for i in range(4):
+            print(f"via gateway {i} ->",
+                  post(gw_info.url, {"f0": -2.0, "f1": 1.0, "f2": 0.0}))
+        forwarded = {r["url"]: r["forwarded"]
+                     for r in gw.describe()["replicas"]}
+        print("forwards per replica:", forwarded)
     finally:
+        gw.stop()
         dist.stop()
 
 

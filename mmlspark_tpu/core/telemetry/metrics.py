@@ -53,6 +53,14 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.pipeline.items": "counter",       # + .<stage> variants
     "xla.compile.count": "counter",       # every observed XLA compile
     "xla.compile.hot_path": "counter",    # + .<fn> variants: steady-state
+    # -- counters: fleet gateway event ledger (serving/fleet.py, PR 9)
+    "serving.fleet.retry": "counter",
+    "serving.fleet.eject": "counter",
+    "serving.fleet.reinstate": "counter",
+    "serving.fleet.no_replica": "counter",
+    "serving.fleet.deadline_expired": "counter",
+    "serving.fleet.rollback": "counter",
+    "serving.fleet.promote": "counter",
     # -- histograms
     "serving.request.latency": "histogram",
     "serving.batch.fill": "histogram",
@@ -63,6 +71,8 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.http.request.latency": "histogram",
     "models.training.step_latency": "histogram",
     "xla.compile.latency": "histogram",
+    "serving.fleet.request.latency": "histogram",   # gateway e2e, labeled
+    "serving.fleet.replica.latency": "histogram",   # labeled {replica=...}
     # -- gauges
     "serving.queue.depth": "gauge",
     "serving.batcher.queue_depth": "gauge",
@@ -74,6 +84,8 @@ DECLARED_METRICS: Dict[str, str] = {
     "device.hbm.bytes_in_use": "gauge",
     "device.hbm.peak_bytes": "gauge",
     "device.live_buffer_count": "gauge",
+    "serving.fleet.replicas": "gauge",
+    "serving.fleet.healthy": "gauge",
 }
 
 

@@ -3,10 +3,21 @@
 Reference: the Spark Serving L6 subsystem (~1.6k LoC; HTTPSourceV2/
 HTTPSinkV2/DistributedHTTPSource, SURVEY §2.4) — sub-millisecond data path:
 accept, batch, jitted transform, reply over the held socket.
+
+Fleet layer (PR 9, docs/serving.md): FleetGateway routes across replica
+pools (p2c balancing, deadline decrement, retry, breaker ejection +
+probe reinstatement); RolloutController drives metrics-gated canaries.
 """
 from .dsl import DistributedServingServer, StreamingQuery, StreamReader, read_stream
+from .fleet import FleetGateway, Replica
 from .journal import EpochJournal
-from .registry import ServiceRegistry, list_services, register_service
+from .registry import (
+    ServiceRegistry,
+    deregister_service,
+    list_services,
+    register_service,
+)
+from .rollout import ROLLOUT_METRICS, RolloutController
 from .server import (
     CachedRequest,
     ServiceInfo,
@@ -26,9 +37,14 @@ __all__ = [
     "make_reply",
     "ServiceRegistry",
     "register_service",
+    "deregister_service",
     "list_services",
     "read_stream",
     "StreamReader",
     "StreamingQuery",
     "DistributedServingServer",
+    "FleetGateway",
+    "Replica",
+    "RolloutController",
+    "ROLLOUT_METRICS",
 ]

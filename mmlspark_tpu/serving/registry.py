@@ -6,39 +6,68 @@ HTTPSourceStateHolder.serviceInfoJson(name) exposes discovery (:409-416).
 
 In a multi-host jax job the registry runs on the coordinator (process 0);
 workers register their per-host serving endpoints over DCN.
+
+Entries are keyed by ``(name, host, port)``: re-registration is a
+heartbeat (it refreshes ``last_seen``, never duplicates), entries older
+than ``ttl_s`` are expired on every read so a dead worker stops being
+discoverable within one TTL, and ``POST /deregister`` removes an entry
+immediately (the graceful half — the gateway uses it when it drains a
+replica out of a fleet, serving/fleet.py).
 """
 from __future__ import annotations
 
 import json
 import threading
+import time
 from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..io.http.clients import send_request
 from ..io.http.schema import HTTPRequestData
 from .server import ServiceInfo
 
-__all__ = ["ServiceRegistry", "register_service", "list_services"]
+__all__ = ["ServiceRegistry", "register_service", "deregister_service",
+           "list_services"]
 
 
 class ServiceRegistry:
-    """Tiny registry server: POST /register, GET /services."""
+    """Tiny registry server: POST /register, POST /deregister,
+    GET /services[/name].
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._services: Dict[str, List[dict]] = {}
+    `ttl_s` is the heartbeat contract: a worker that has not re-POSTed
+    /register within `ttl_s` seconds is expired on the next read
+    (`ttl_s=None` disables expiry).  The clock is injectable so tests
+    can expire entries without sleeping.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ttl_s: Optional[float] = 30.0, clock=time.monotonic):
+        # (name, host, port) -> info dict (+ "_last_seen" stamp)
+        self._services: Dict[Tuple[str, str, int], dict] = {}
         self._lock = threading.Lock()
+        self.ttl_s = None if ttl_s is None else float(ttl_s)
+        self._clock = clock
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):
-                if self.path.rstrip("/") != "/register":
+                path = self.path.rstrip("/")
+                if path not in ("/register", "/deregister"):
                     self.send_error(404)
                     return
                 length = int(self.headers.get("Content-Length", 0))
-                info = json.loads(self.rfile.read(length))
-                with outer._lock:
-                    outer._services.setdefault(info["name"], []).append(info)
+                try:
+                    info = json.loads(self.rfile.read(length))
+                    key = (str(info["name"]), str(info["host"]),
+                           int(info["port"]))
+                except (ValueError, KeyError, TypeError):
+                    self.send_error(400, "need JSON with name/host/port")
+                    return
+                if path == "/register":
+                    outer._put(key, info)
+                else:
+                    outer._remove(key)
                 self.send_response(200)
                 self.send_header("Content-Length", "2")
                 self.end_headers()
@@ -49,13 +78,10 @@ class ServiceRegistry:
                     self.send_error(404)
                     return
                 name = self.path.rstrip("/").split("/")[-1]
-                with outer._lock:
-                    if name and name != "services":
-                        body = json.dumps(
-                            outer._services.get(name, [])
-                        ).encode()
-                    else:
-                        body = json.dumps(outer._services).encode()
+                if name and name != "services":
+                    body = json.dumps(outer.services(name)).encode()
+                else:
+                    body = json.dumps(outer.services()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -70,6 +96,30 @@ class ServiceRegistry:
             target=self._httpd.serve_forever, daemon=True, name="svc-registry"
         )
 
+    # ---- store ---------------------------------------------------------
+    def _put(self, key: Tuple[str, str, int], info: dict):
+        with self._lock:
+            entry = dict(info)
+            entry["_last_seen"] = self._clock()
+            self._services[key] = entry
+
+    def _remove(self, key: Tuple[str, str, int]):
+        with self._lock:
+            self._services.pop(key, None)
+
+    def _prune_locked(self):
+        if self.ttl_s is None:
+            return
+        cutoff = self._clock() - self.ttl_s
+        for k in [k for k, v in self._services.items()
+                  if v.get("_last_seen", 0.0) < cutoff]:
+            del self._services[k]
+
+    @staticmethod
+    def _public(entry: dict) -> dict:
+        return {k: v for k, v in entry.items() if not k.startswith("_")}
+
+    # ---- server lifecycle ---------------------------------------------
     @property
     def url(self) -> str:
         h, p = self._httpd.server_address[:2]
@@ -83,16 +133,34 @@ class ServiceRegistry:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    # ---- read side (TTL expiry happens here) --------------------------
     def services(self, name: Optional[str] = None):
         with self._lock:
+            self._prune_locked()
             if name is not None:
-                return list(self._services.get(name, []))
-            return {k: list(v) for k, v in self._services.items()}
+                return [self._public(v) for (n, _h, _p), v
+                        in self._services.items() if n == name]
+            out: Dict[str, List[dict]] = {}
+            for (n, _h, _p), v in self._services.items():
+                out.setdefault(n, []).append(self._public(v))
+            return out
 
 
 def register_service(registry_url: str, info: ServiceInfo) -> bool:
+    """Register (or heartbeat) one endpoint.  Idempotent: the registry
+    keys on (name, host, port), so re-POSTing refreshes `last_seen`."""
     resp = send_request(HTTPRequestData(
         url=registry_url.rstrip("/") + "/register",
+        headers={"Content-Type": "application/json"},
+        entity=json.dumps(asdict(info)).encode(),
+    ), timeout=10.0)
+    return resp.ok
+
+
+def deregister_service(registry_url: str, info: ServiceInfo) -> bool:
+    """Remove one endpoint immediately (graceful shutdown / drain)."""
+    resp = send_request(HTTPRequestData(
+        url=registry_url.rstrip("/") + "/deregister",
         headers={"Content-Type": "application/json"},
         entity=json.dumps(asdict(info)).encode(),
     ), timeout=10.0)

@@ -40,12 +40,18 @@ __all__ = ["CachedRequest", "WorkerServer", "ServingServer", "ServiceInfo",
 
 @dataclass
 class ServiceInfo:
-    """What a worker reports to the registry (HTTPSourceV2 ServiceInfo)."""
+    """What a worker reports to the registry (HTTPSourceV2 ServiceInfo).
+
+    `version` and `weight` feed the fleet control plane (serving/fleet.py):
+    the gateway groups replicas by version for canary splits and uses the
+    per-replica weight inside a version group."""
 
     name: str
     host: str
     port: int
     path: str
+    version: str = "v1"
+    weight: float = 1.0
 
     @property
     def url(self) -> str:
@@ -139,6 +145,16 @@ class WorkerServer:
             disable_nagle_algorithm = True
 
             def do_POST(self):
+                if self.path.rstrip("/") == "/admin/drain":
+                    # remote rolling-drain hook (fleet rollouts): flip to
+                    # draining, let the poller watch /health for drained
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self.rfile.read(length)  # keep-alive framing
+                    outer.begin_drain()
+                    self._reply_bytes(200, b'{"draining": true}',
+                                      {"Content-Type": "application/json"})
+                    return
                 if self.path.rstrip("/") != outer.path.rstrip("/"):
                     self.send_error(404)
                     return
@@ -244,6 +260,21 @@ class WorkerServer:
                 tree as JSON) and `/trace.json` (the whole span ring as
                 Chrome/Perfetto trace-event JSON)."""
                 path = self.path.split("?", 1)[0]
+                if path.rstrip("/") == "/health":
+                    # liveness + drain progress for the fleet gateway's
+                    # active prober and rolling-drain poller.  Always 200
+                    # while the process serves: "draining" is a routing
+                    # hint, not an error.
+                    draining = outer._draining.is_set()
+                    payload = json.dumps({
+                        "status": "draining" if draining else "ok",
+                        "draining": draining,
+                        "drained": outer.drained(),
+                        "queue_depth": outer.queue.qsize(),
+                    }).encode("utf-8")
+                    self._reply_bytes(200, payload,
+                                      {"Content-Type": "application/json"})
+                    return
                 if path.rstrip("/") == "/metrics":
                     try:
                         # freshen the device gauges on every scrape;

@@ -6,7 +6,8 @@ was only ever *exercised* by production incidents; this module makes the
 failure paths testable on demand.  Production code declares **named fault
 points** (`fault_point("feed.device_put")`) at every site that can fail
 in the field — a transfer, a batch-loop tick, an HTTP send, a training
-step.  By default a fault point is a no-op costing one attribute load and
+step, a gateway forward or health probe (`fleet.forward`,
+`fleet.health` in serving/fleet.py).  By default a fault point is a no-op costing one attribute load and
 one branch.  Tests (and `tools/chaos_soak.py`) arm a seeded `FaultPlan`
 through the process-global injector:
 

@@ -21,7 +21,11 @@ get 503 + Retry-After; deadline-expired get 504; nothing is lost (every
 client gets exactly one response) and nothing is duplicated (each
 request id's reply observed once).  See docs/robustness.md.
 
-Usage: python tools/chaos_soak.py [--seed N] [--requests N] [--json]
+Usage: python tools/chaos_soak.py [--seed N] [--requests N] [--gateway]
+                                  [--json]
+`--gateway` runs the same plan with two replicas behind the fleet
+gateway (serving/fleet.py) — same exactly-once assertions, fleet-shaped
+shed/deadline accounting.
 Also importable (tests/test_chaos.py): run_soak(...) returns the summary.
 """
 from __future__ import annotations
@@ -59,9 +63,17 @@ def _make_model():
 
 def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
              transfer_fail_p: float = 0.2, crash_nth=(1, 4, 8),
-             n_expired: int = 3) -> dict:
+             n_expired: int = 3, gateway: bool = False) -> dict:
     """One seeded soak; returns a JSON-able summary dict.  Raises
-    AssertionError if any robustness invariant is violated."""
+    AssertionError if any robustness invariant is violated.
+
+    `gateway=True` runs the same fault plan with TWO replicas behind a
+    FleetGateway (serving/fleet.py) and drives all traffic through the
+    gateway: the exactly-once/payload invariants are unchanged, but the
+    shed and deadline accounting moves — the gateway retries a replica's
+    503 on the alternate (so replica-level sheds >= client-observed
+    503s) and fails an already-expired budget at the gateway without
+    forwarding (serving.fleet.deadline_expired)."""
     from mmlspark_tpu.core import telemetry
     from mmlspark_tpu.io.http.clients import send_request
     from mmlspark_tpu.io.http.schema import to_http_request
@@ -70,14 +82,29 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
 
     telemetry.reset_counters()
     model = _make_model()
-    srv = ServingServer(
-        model, reply_col="y", name="chaos-soak", path="/soak",
-        input_schema=["v"], max_batch=4, batch_timeout_ms=20.0,
-        # every crash costs one attempt on the whole batch: the budget
-        # must cover len(crash_nth) replays of an unlucky request plus
-        # the original try, or a thrice-crashed request 500s
-        max_attempts=len(crash_nth) + 2,
-        max_queue=max_queue)
+
+    def make_server(m):
+        return ServingServer(
+            m, reply_col="y", name="chaos-soak", path="/soak",
+            input_schema=["v"], max_batch=4, batch_timeout_ms=20.0,
+            # every crash costs one attempt on the whole batch: the budget
+            # must cover len(crash_nth) replays of an unlucky request plus
+            # the original try, or a thrice-crashed request 500s
+            max_attempts=len(crash_nth) + 2,
+            max_queue=max_queue)
+
+    srv = make_server(model)
+    servers = [srv]
+    gw = None
+    if gateway:
+        import random
+
+        from mmlspark_tpu.serving import FleetGateway
+
+        servers.append(make_server(_make_model()))
+        gw = FleetGateway(name="chaos-gw", path="/soak",
+                          probe_interval_s=0.1, retries=2,
+                          rng=random.Random(seed))
     plan = (FaultPlan(seed=seed)
             .on("feed.device_put", probability=transfer_fail_p,
                 max_failures=max(4, n_requests // 4))
@@ -104,6 +131,11 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
 
     threading.excepthook = quiet_injected
     info = srv.start()
+    if gateway:
+        servers[1].start()
+        for s in servers:
+            gw.add_server(s, version="v1")
+        info = gw.start()
     try:
         with FAULTS.arm(plan):
             threads = [
@@ -129,11 +161,17 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
             for j in range(n_expired):
                 post(info.url, {"v": -1}, n_requests + j,
                      headers={"X-Deadline-Ms": "0"})
-            srv.stop()  # graceful drain: no accepted request stranded
+            if gw is not None:
+                gw.stop()
+            for s in servers:
+                s.stop()  # graceful drain: no accepted request stranded
     finally:
         threading.excepthook = prev_hook
-        if srv._running.is_set():
-            srv.stop(drain=False)
+        if gw is not None and gw._running.is_set():
+            gw.stop()
+        for s in servers:
+            if s._running.is_set():
+                s.stop(drain=False)
 
     # ---- invariants ----------------------------------------------------
     lost = [i for i, r in enumerate(results) if r is None]
@@ -176,17 +214,32 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
     assert snap_counters.get("faults.injected", 0) == sum(fires.values()), \
         (f"registry faults.injected {snap_counters.get('faults.injected')} "
          f"!= fault-injector fires {sum(fires.values())}")
-    assert snap_counters.get("serving.shed", 0) == len(shed), \
-        (f"registry serving.shed {snap_counters.get('serving.shed')} != "
-         f"observed 503s {len(shed)}")
-    assert snap_counters.get("serving.deadline_expired", 0) >= n_expired, \
-        "deadline expiries missing from the registry snapshot"
+    if gateway:
+        # the gateway retries a replica's 503 on the alternate, so some
+        # replica-level sheds never reach a client; and an already-
+        # expired budget 504s AT the gateway, never forwarded
+        assert snap_counters.get("serving.shed", 0) >= len(shed), \
+            (f"registry serving.shed {snap_counters.get('serving.shed')} "
+             f"< client-observed 503s {len(shed)}")
+        expired_total = (snap_counters.get("serving.fleet.deadline_expired",
+                                           0)
+                         + snap_counters.get("serving.deadline_expired", 0))
+        assert expired_total >= n_expired, \
+            "deadline expiries missing from the registry snapshot"
+    else:
+        assert snap_counters.get("serving.shed", 0) == len(shed), \
+            (f"registry serving.shed {snap_counters.get('serving.shed')} "
+             f"!= observed 503s {len(shed)}")
+        assert snap_counters.get("serving.deadline_expired",
+                                 0) >= n_expired, \
+            "deadline expiries missing from the registry snapshot"
     assert any(k.startswith("serving.request.latency")
                for k in snapshot["histograms"]), \
         "serving.request.latency histogram missing from the snapshot"
 
     return {
         "seed": seed,
+        "gateway": gateway,
         "requests": n_requests + n_expired,
         "answered_200": len(ok),
         "shed_503": len(shed),
@@ -195,8 +248,8 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
         "duplicated": 0,
         "feed_degraded": bool(model._soak_feed.degraded),
         "faults_fired": fires,
-        "recoveries": srv.stats["recoveries"],
-        "replayed": srv.stats["replayed"],
+        "recoveries": sum(s.stats["recoveries"] for s in servers),
+        "replayed": sum(s.stats["replayed"] for s in servers),
         "counters": snap_counters,
         "gauges": snapshot["gauges"],
         "latency_p95_s": {
@@ -226,6 +279,9 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--max-queue", type=int, default=8)
+    ap.add_argument("--gateway", action="store_true",
+                    help="drive traffic through a FleetGateway fronting "
+                         "two replicas instead of a single worker")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON object")
     ap.add_argument("--obs-out", metavar="PATH", default=None,
@@ -233,7 +289,7 @@ def main(argv=None):
                          "included) to PATH for tools/obs_report.py")
     args = ap.parse_args(argv)
     summary = run_soak(seed=args.seed, n_requests=args.requests,
-                       max_queue=args.max_queue)
+                       max_queue=args.max_queue, gateway=args.gateway)
     if args.obs_out:
         write_obs_snapshot(args.obs_out)
     if args.json:

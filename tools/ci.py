@@ -9,6 +9,7 @@ anywhere:
     python tools/ci.py metrics-lint         # declared-metric-name check only
     python tools/ci.py perf-gate --fresh /tmp/bench_obs.json
                                             # bench regression gate
+    python tools/ci.py fleet-smoke          # gateway kill/revive soak
     python tools/ci.py test [--shards N] [--shard K] [--retries R]
     python tools/ci.py all                  # lint + every shard
 
@@ -309,10 +310,26 @@ def perf_gate(fresh: str, against: str = None, scale: float = 1.0) -> int:
     return gate.main(argv)
 
 
+def fleet_smoke(timeout_s: int = 300) -> int:
+    """Run the fleet kill/revive soak (tools/fleet_soak.py) as a smoke
+    job: 2 replicas behind the gateway, a scripted mid-traffic kill, the
+    exactly-once + eject/reinstate assertions.  CPU backend so the job
+    runs on any CI machine."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join("tools", "fleet_soak.py"), "--json"]
+    try:
+        rc = subprocess.call(cmd, cwd=ROOT, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"fleet-smoke timed out after {timeout_s}s")
+        return 1
+    print("fleet-smoke:", "OK" if rc == 0 else f"FAILED (rc={rc})")
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("command", choices=["lint", "metrics-lint", "test",
-                                        "perf-gate", "all"])
+                                        "perf-gate", "fleet-smoke", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
@@ -336,6 +353,8 @@ def main(argv=None):
         if not args.fresh:
             ap.error("perf-gate requires --fresh SNAPSHOT")
         return perf_gate(args.fresh, args.against, args.scale)
+    if args.command == "fleet-smoke":
+        return fleet_smoke()
     if args.command == "test":
         return test(args.shards, args.shard, args.retries, args.timeout)
     rc = lint()

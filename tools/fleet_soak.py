@@ -1,0 +1,236 @@
+"""Fleet soak: kill replicas mid-traffic behind the gateway, assert
+exactly-once.
+
+Runs >= 2 real ServingServer replicas behind a FleetGateway
+(serving/fleet.py) while concurrent clients post through the gateway,
+then hard-kills one replica mid-traffic (`stop(drain=False)` — the
+process-death simulation) and later revives a fresh server on the SAME
+address:
+
+  * requests in flight at the kill resolve as upstream 504s (the dead
+    consumer never answers) or transport errors — both retried on the
+    surviving replica within the client's deadline budget;
+  * new forwards to the dead address get connection-refused -> the
+    replica's circuit breaker opens (passive ejection,
+    `serving.fleet.eject`);
+  * the revived server answers the gateway's active /health probe ->
+    breaker closes, replica reinstated (`serving.fleet.reinstate`) and
+    verifiably serves the second traffic wave.
+
+The invariant is the fleet-level exactly-once contract: EVERY client
+request is answered exactly once with ITS OWN correct payload (y = 3*v
+echoes the request id, so a cross-wired retry or a duplicated reply
+cannot hide), 0 lost, 0 duplicated, across both the kill and the
+revival.  See docs/serving.md.
+
+Usage: python tools/fleet_soak.py [--seed N] [--requests N] [--json]
+Also importable (tests/test_fleet.py): run_soak(...) returns the summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _make_server(host: str = "127.0.0.1", port: int = 0):
+    import numpy as np
+
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+    from mmlspark_tpu.serving import ServingServer
+
+    def fn(table):
+        v = np.asarray(table["v"], np.int64)
+        return table.with_column("y", v * 3)
+
+    srv = ServingServer(
+        LambdaTransformer(fn), reply_col="y", name="fleet-soak",
+        host=host, port=port, input_schema=["v"],
+        max_batch=8, batch_timeout_ms=10.0, max_queue=256)
+    # a hard-killed replica's held exchanges resolve (504) on this bound;
+    # keep it short so the gateway's retry answers the client quickly
+    srv.server.handler_timeout = 1.5
+    return srv
+
+
+def run_soak(seed: int = 7, n_requests: int = 60, n_replicas: int = 2,
+             kill_after: int = 15, n_verify: int = 24,
+             concurrency: int = 8, deadline_ms: float = 20000.0) -> dict:
+    """Drive the kill/revive scenario; returns the summary dict.
+    Raises AssertionError on any lost/duplicated/cross-wired reply or a
+    missing eject/reinstate transition."""
+    import random
+
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.io.http.clients import send_request
+    from mmlspark_tpu.io.http.schema import to_http_request
+    from mmlspark_tpu.serving import FleetGateway
+
+    assert n_replicas >= 2, "the kill scenario needs a surviving replica"
+    c0 = telemetry.counters()
+
+    replicas = [_make_server() for _ in range(n_replicas)]
+    for r in replicas:
+        r.start()
+    gw = FleetGateway(name=f"fleet-soak-{replicas[0].service_info.port}",
+                      probe_interval_s=0.05, retries=max(2, n_replicas),
+                      breaker_threshold=1, breaker_reset_s=0.3,
+                      forward_timeout_s=10.0,
+                      rng=random.Random(seed))
+    handles = [gw.add_server(r, version="v1") for r in replicas]
+    gw.start()
+
+    results: dict = {}
+    res_lock = threading.Lock()
+
+    def post(i: int):
+        r = send_request(to_http_request(
+            gw.url, {"v": i},
+            headers={"X-Deadline-Ms": str(deadline_ms)}), timeout=15.0)
+        try:
+            payload = r.json()
+        except ValueError:
+            payload = r.entity
+        with res_lock:
+            results.setdefault(i, []).append((r.status_code, payload))
+
+    def wave(ids, on_count=None, action=None):
+        """Post `ids` with at most `concurrency` in flight.  `action`
+        fires (from a watcher thread) as soon as `on_count` replies have
+        landed — i.e. mid-wave, with requests still in the air."""
+        sem = threading.BoundedSemaphore(concurrency)
+
+        def run(i):
+            try:
+                post(i)
+            finally:
+                sem.release()
+
+        watcher = None
+        if action is not None:
+            def watch():
+                while True:
+                    with res_lock:
+                        if len(results) >= on_count:
+                            break
+                    time.sleep(0.005)
+                action()
+
+            watcher = threading.Thread(target=watch, daemon=True)
+            watcher.start()
+        threads = []
+        for i in ids:
+            sem.acquire()
+            t = threading.Thread(target=run, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), \
+                "client thread still waiting: a reply was lost"
+        if watcher is not None:
+            watcher.join(timeout=30.0)
+
+    victim = replicas[0]
+    victim_info = victim.service_info
+    kill_done = threading.Event()
+
+    def kill():
+        victim.stop(drain=False)  # hard stop: the process-death analog
+        kill_done.set()
+
+    try:
+        # ---- wave 1: kill mid-traffic ------------------------------
+        wave(range(n_requests), on_count=kill_after, action=kill)
+        assert kill_done.is_set(), "scripted kill never fired"
+
+        # exactly-once, correct-payload audit
+        lost = [i for i in range(n_requests) if not results.get(i)]
+        dup = {i: r for i, r in results.items() if len(r) > 1}
+        wrong = {i: r for i, r in results.items()
+                 if len(r) == 1 and (r[0][0] != 200
+                                     or r[0][1] != {"y": 3 * i})}
+        assert not lost, f"lost replies: {lost}"
+        assert not dup, f"duplicated replies: {dup}"
+        assert not wrong, f"wrong/cross-wired replies: {wrong}"
+
+        c1 = telemetry.counters()
+        ejects = c1.get("serving.fleet.eject", 0) - \
+            c0.get("serving.fleet.eject", 0)
+        retries = c1.get("serving.fleet.retry", 0) - \
+            c0.get("serving.fleet.retry", 0)
+        assert ejects >= 1, "dead replica was never ejected"
+        dead = handles[0]
+        assert not dead.routable(), "dead replica still routable"
+
+        # ---- revive on the SAME address ----------------------------
+        revived = _make_server(host=victim_info.host,
+                               port=victim_info.port)
+        revived.start()
+        handles[0].server = revived  # fresh lifecycle handle
+        replicas[0] = revived
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not dead.routable():
+            time.sleep(0.05)
+        assert dead.routable(), "probe never reinstated revived replica"
+        c2 = telemetry.counters()
+        reinstates = c2.get("serving.fleet.reinstate", 0) - \
+            c0.get("serving.fleet.reinstate", 0)
+        assert reinstates >= 1, "reinstate counter never fired"
+
+        # ---- wave 2: revived replica verifiably serves -------------
+        served_before = dead.forwarded
+        wave(range(n_requests, n_requests + n_verify))
+        lost2 = [i for i in range(n_requests, n_requests + n_verify)
+                 if not results.get(i)]
+        wrong2 = {i: r for i, r in results.items()
+                  if i >= n_requests and (len(r) != 1 or r[0][0] != 200
+                                          or r[0][1] != {"y": 3 * i})}
+        assert not lost2 and not wrong2, (lost2, wrong2)
+        revived_served = dead.forwarded - served_before
+        assert revived_served > 0, \
+            "revived replica took no traffic after reinstatement"
+
+        return {
+            "requests": n_requests + n_verify,
+            "lost": 0,
+            "duplicated": 0,
+            "ejects": ejects,
+            "retries": retries,
+            "reinstates": reinstates,
+            "revived_served": revived_served,
+            "per_replica_forwarded": {h.key: h.forwarded for h in handles},
+        }
+    finally:
+        gw.stop()
+        for r in replicas:
+            try:
+                r.stop(drain=False)
+            except Exception:  # noqa: BLE001 — victim already stopped
+                pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON")
+    args = ap.parse_args(argv)
+    report = run_soak(seed=args.seed, n_requests=args.requests,
+                      n_replicas=args.replicas)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print("fleet-soak OK:", report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

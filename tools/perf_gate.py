@@ -76,13 +76,18 @@ def _numeric(v: Any) -> Optional[float]:
 
 
 def compare(fresh: Dict[str, Any], base: Dict[str, Any],
-            scale: float = 1.0) -> Tuple[List[Dict[str, Any]], List[str]]:
+            scale: float = 1.0,
+            metrics: Optional[Dict[str, Tuple[str, float, float]]] = None,
+            ) -> Tuple[List[Dict[str, Any]], List[str]]:
     """Rows for every gated metric both records carry, plus the names
     present on only one side.  `scale` widens every relative band
-    (--scale 2 for a known-noisy machine)."""
+    (--scale 2 for a known-noisy machine).  `metrics` swaps in an
+    alternate band table — the serving RolloutController reuses this
+    engine to diff a canary's latency/error-rate against its baseline
+    (serving/rollout.py)."""
     rows: List[Dict[str, Any]] = []
     untracked: List[str] = []
-    for name, (direction, rel, floor) in GATE_METRICS.items():
+    for name, (direction, rel, floor) in (metrics or GATE_METRICS).items():
         f, b = _numeric(fresh.get(name)), _numeric(base.get(name))
         if f is None or b is None:
             if (name in fresh) != (name in base):
