@@ -163,6 +163,117 @@ def _measure_train(batch: int = 256, steps: int = 40) -> dict:
     return {"train_samples_per_sec": round(steps * batch / dt, 1)}
 
 
+def _measure_guard(steps: int = 96, batch: int = 32,
+                   reps: int = 5) -> dict:
+    """Host-loop cost of the training-guard plumbing (PR 10).  The
+    reliability ladder's contract is that the guard-DISABLED path —
+    fit_epochs_resumable's default, where every fault point is disarmed
+    and every guard branch short-circuits on `guard is None` — adds
+    <1% per-step overhead versus the bare pre-guard loop body.  Measured
+    here as the median per-step wall of fit_epochs_resumable(guard=None)
+    against a reference loop with the identical feed/span/step body and
+    no guard/checkpoint plumbing at all; perf_gate bands
+    `guard_overhead_frac`.  The guard-ENABLED fraction rides along as an
+    informational field (it buys the whole anomaly ladder; it is not
+    gated)."""
+    import statistics
+    import tempfile
+
+    import jax
+    import optax
+    import flax.linen as nn
+    import numpy as np
+
+    from mmlspark_tpu.core import telemetry as core_telemetry
+    from mmlspark_tpu.io.feed import DeviceFeed
+    from mmlspark_tpu.models.guard import TrainingGuard
+    from mmlspark_tpu.models.training import (fit_epochs_resumable,
+                                              init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import batch_sharding, default_mesh
+
+    class M(nn.Module):
+        # sized so one step costs ~1-3 ms: a 4x4 micro-model would make
+        # the denominator so small that microseconds of host plumbing
+        # read as whole percents, gating noise instead of overhead
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(256)(x))
+            return nn.Dense(4)(x), {}
+
+    mesh = default_mesh()
+    model, opt = M(), optax.sgd(0.1)
+    n = steps * batch
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(n, 16, 16, 3)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=n).astype(np.int32)
+    step = make_train_step(model, opt, 4, mesh=mesh, donate=False)
+    state0 = init_train_state(model, opt, (16, 16, 3), seed=0)
+    img_sh = batch_sharding(mesh, 4)
+    lbl_sh = batch_sharding(mesh, 1)
+    # compile outside every timed window
+    jax.block_until_ready(step(state0, imgs[:batch], lbls[:batch])[1]["loss"])
+
+    def median_step_s(times):
+        # consecutive log/loop timestamps: excludes manager setup,
+        # resume probing, and the final checkpoint write
+        deltas = [b - a for a, b in zip(times, times[1:])]
+        return statistics.median(deltas[2:])  # drop warm-in steps
+
+    def run_reference():
+        """The pre-guard loop body, verbatim: feed + span + step +
+        host-float metric pulls + latency instrumentation + the same
+        log_fn call shape (int(state.step) pulls a device scalar — both
+        sides must pay it)."""
+        order = np.random.default_rng([7, 0]).permutation(n)
+        feed = DeviceFeed(mesh=mesh)
+        state, times = state0, []
+        for g in range(steps):
+            idx = order[g * batch:(g + 1) * batch]
+            dbi, dbl = feed.put_group([imgs[idx], lbls[idx]],
+                                      shardings=(img_sh, lbl_sh))
+            t0 = time.perf_counter()
+            with core_telemetry.span("training.step"):
+                state, m = step(state, dbi, dbl)
+                metrics = {k: float(v) for k, v in m.items()}
+            dt = time.perf_counter() - t0
+            core_telemetry.histogram(
+                "models.training.step_latency").observe(dt)
+            core_telemetry.gauge("models.training.examples_per_sec").set(
+                batch / dt if dt > 0 else 0.0)
+            _ = (int(state.step), metrics)
+            times.append(time.perf_counter())
+        return median_step_s(times)
+
+    def run_resumable(guard):
+        times = []
+        with tempfile.TemporaryDirectory() as ck:
+            fit_epochs_resumable(
+                step, state0, imgs, lbls, batch_size=batch,
+                checkpoint_dir=ck, epochs=1, checkpoint_every=10**9,
+                mesh=mesh, seed=7, guard=guard,
+                log_fn=lambda s, m: times.append(time.perf_counter()))
+        return median_step_s(times)
+
+    # interleaved best-of-N: min-of-medians cancels machine-load drift
+    # that a single pair of runs (≈±4% on a busy host) would bake into
+    # the fraction — the band on this metric is one absolute point
+    refs, dis = [], []
+    for _ in range(reps):
+        refs.append(run_reference())
+        dis.append(run_resumable(None))
+    enabled = run_resumable(TrainingGuard(hang_timeout_s=3600.0))
+    ref, disabled = min(refs), min(dis)
+    # clamp at zero: the resumable loop is a superset of the reference,
+    # so a negative fraction is measurement noise — and a negative
+    # LASTGOOD base would tighten perf_gate's absolute band for free
+    return {
+        "guard_overhead_frac": max(0.0, round((disabled - ref) / ref, 4)),
+        "guard_enabled_overhead_frac": round((enabled - ref) / ref, 4),
+    }
+
+
 def _measure_transformer(batch: int = 16, seq: int = 1024,
                          steps: int = 8,
                          force_xla_attn: bool = False) -> dict:
@@ -505,6 +616,10 @@ def _child_measure():
                 lm["lm_attn_fallback"] = True
             except Exception as e2:  # noqa: BLE001
                 lm = {"lm_error": f"{str(e)[-120:]} | retry: {str(e2)[-120:]}"}
+    try:
+        guard = _measure_guard()
+    except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
+        guard = {"guard_error": str(e)[-200:]}
     # the registry's own view of the run rides along so --obs-out saves
     # a self-describing snapshot (meta: backend/devices/pid/timestamp)
     from mmlspark_tpu.core import telemetry as core_telemetry
@@ -513,7 +628,7 @@ def _child_measure():
         include_spans=False,
         timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm,
-                      "obs": obs}))
+                      "guard": guard, "obs": obs}))
 
 
 def _obs_out_path():
@@ -651,6 +766,8 @@ def main():
            and "train_error" in train else {}),
         **{k: v for k, v in child.get("vit", {}).items() if v is not None},
         **{k: v for k, v in child.get("lm", {}).items() if v is not None},
+        **{k: v for k, v in child.get("guard", {}).items()
+           if v is not None},
         "device_kind": res["device_kind"],
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

@@ -50,6 +50,15 @@ DECLARED_METRICS: Dict[str, str] = {
     "faults.injected": "counter",         # + .<fault-point> variants
     "training.autosave": "counter",
     "training.resume": "counter",
+    # -- counters: training reliability ladder (models/guard.py, PR 10)
+    "training.anomaly": "counter",        # + .<kind> variants
+    "training.quarantine": "counter",     # + .skip variant (replay skips)
+    "training.rollback": "counter",
+    "training.abort": "counter",
+    "training.hang": "counter",
+    "checkpoint.corrupt": "counter",
+    "checkpoint.fallback": "counter",
+    "checkpoint.write_failed": "counter",
     "io.pipeline.items": "counter",       # + .<stage> variants
     "xla.compile.count": "counter",       # every observed XLA compile
     "xla.compile.hot_path": "counter",    # + .<fn> variants: steady-state
@@ -70,6 +79,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.pipeline.stage.latency": "histogram",   # labeled {stage=...}
     "io.http.request.latency": "histogram",
     "models.training.step_latency": "histogram",
+    "checkpoint.verify.latency": "histogram",
     "xla.compile.latency": "histogram",
     "serving.fleet.request.latency": "histogram",   # gateway e2e, labeled
     "serving.fleet.replica.latency": "histogram",   # labeled {replica=...}
@@ -81,6 +91,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.feed.stall_s": "gauge",
     "io.pipeline.queue.depth": "gauge",   # + .<stage> variants
     "models.training.examples_per_sec": "gauge",
+    "training.guard.lr_scale": "gauge",
     "device.hbm.bytes_in_use": "gauge",
     "device.hbm.peak_bytes": "gauge",
     "device.live_buffer_count": "gauge",

@@ -1,4 +1,4 @@
-"""Training checkpoint / resume via orbax.
+"""Training checkpoint / resume via orbax, with integrity verification.
 
 Reference: checkpoint/resume in the reference is ComplexParams save/load for
 models plus engine warm-start (SURVEY §5: LightGBM modelString, VW
@@ -6,23 +6,100 @@ initialModel bytes, streaming checkpointLocation).  The TPU build's training
 loops additionally need step-level checkpointing of (params, batch_stats,
 opt_state, step): orbax handles atomic async writes, retention, and
 restore-into-sharded-arrays.
+
+On top of orbax this module adds **verified checkpoints** (Check-N-Run-style
+checksummed saves), because a resumable training loop is only as reliable as
+the bytes it resumes from:
+
+* every synchronous ``save()`` writes a **manifest**
+  (``manifest.mmlspark.json`` inside the step directory) holding a crc32 +
+  dtype + shape per pytree leaf, written atomically — tmp file, fsync,
+  rename — so a crash mid-write leaves either no manifest or a complete
+  one, never a torn one.  A manifest that *exists but does not parse* is a
+  torn write from a dying filesystem: the checkpoint is treated as absent
+  (and counted ``checkpoint.corrupt``).
+* ``restore()`` re-hashes every leaf and compares against the manifest
+  (``checkpoint.verify.latency`` histogram); a mismatch raises
+  :class:`CheckpointCorruptError` and counts ``checkpoint.corrupt``.
+  Checkpoints from before this scheme (no manifest) restore unverified —
+  legacy acceptance, not an error.
+* ``restore_verified()`` is the self-healing entry the training loop uses:
+  walk checkpoints newest-first, return the first one that restores AND
+  verifies, counting ``checkpoint.fallback`` for every corrupt step it
+  walks past.
+* fault points ``checkpoint.write`` / ``checkpoint.read`` let chaos tests
+  inject torn writes and read errors deterministically (utils/faults.py).
+
+``save(wait=False)`` keeps orbax's async write path (deep_vision's
+epoch-boundary saves overlap the next epoch) but cannot checksum bytes that
+are not on disk yet — async saves carry no manifest and restore as legacy/
+unverified.  The training loop always saves with ``wait=True``.
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+import time
+import zlib
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
 
+from ..core import telemetry as core_telemetry
+from ..utils.faults import fault_point
 from .training import TrainState
 
-__all__ = ["CheckpointManager", "save_checkpoint", "restore_checkpoint",
-           "latest_step"]
+__all__ = ["CheckpointManager", "CheckpointCorruptError", "MANIFEST_NAME",
+           "save_checkpoint", "restore_checkpoint", "latest_step"]
+
+MANIFEST_NAME = "manifest.mmlspark.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint's bytes do not match its manifest (or cannot be read):
+    restoring it would silently poison the run."""
+
+
+def _leaf_digests(payload) -> Dict[str, Dict]:
+    """crc32 + dtype + shape per leaf, keyed by jax keystr path — cheap
+    enough to run at every save/restore (zlib.crc32 is ~GB/s) and strong
+    enough to catch truncation, bit rot, and wrong-leaf swaps."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(payload)
+    out = {}
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        out[jax.tree_util.keystr(path)] = {
+            "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+        }
+    return out
+
+
+def _write_manifest(step_dir: str, mgr_step: int, state_step: int,
+                    digests: Dict[str, Dict]) -> None:
+    """Atomic manifest write: tmp + fsync + rename (+ directory fsync so
+    the rename itself survives power loss)."""
+    doc = {"format": 1, "step": int(mgr_step), "state_step": int(state_step),
+           "leaves": digests}
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(step_dir, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 class CheckpointManager:
-    """Thin orbax wrapper with TrainState pack/unpack + retention."""
+    """Thin orbax wrapper with TrainState pack/unpack + retention +
+    per-leaf checksum manifests."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         import orbax.checkpoint as ocp
@@ -36,13 +113,18 @@ class CheckpointManager:
             ),
         )
 
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
     def save(self, state: TrainState, step: Optional[int] = None,
              wait: bool = True) -> int:
         import orbax.checkpoint as ocp
 
-        # the manager's numbering (`step` arg, e.g. an epoch count) is
-        # independent of the state's per-batch counter, which must survive
-        # the round trip for anything keyed off TrainState.step
+        fault_point("checkpoint.write")
+        # the manager's numbering (`step` arg, e.g. an epoch count or the
+        # loop's schedule position) is independent of the state's per-batch
+        # counter, which must survive the round trip for anything keyed off
+        # TrainState.step
         mgr_step = int(state.step if step is None else step)
         payload = {
             "params": state.params,
@@ -52,19 +134,86 @@ class CheckpointManager:
         }
         self._mgr.save(mgr_step, args=ocp.args.StandardSave(payload))
         if wait:
+            # the manifest can only attest bytes that are on disk, so it is
+            # written after the orbax write completes; async saves
+            # (wait=False) stay manifest-less and restore as legacy
             self._mgr.wait_until_finished()
+            host = jax.tree.map(lambda x: np.asarray(x), payload)
+            _write_manifest(self._step_dir(mgr_step), mgr_step,
+                            int(state.step), _leaf_digests(host))
         return mgr_step
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return sorted(int(s) for s in self._mgr.all_steps())
+
+    def delete(self, step: int) -> None:
+        """Drop one step (checkpoint + manifest) — used when a rollback
+        replay re-saves a schedule position it already passed."""
+        self._mgr.delete(int(step))
+
+    # ------------------------------------------------------ integrity
+
+    def _read_manifest(self, step: int) -> Optional[Dict]:
+        """None ⇒ no manifest (legacy / async save: accept unverified).
+        Raises CheckpointCorruptError on a torn (unparseable) manifest."""
+        path = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict) or "leaves" not in doc:
+                raise ValueError("manifest missing 'leaves'")
+            return doc
+        except (OSError, ValueError) as e:
+            core_telemetry.incr("checkpoint.corrupt")
+            raise CheckpointCorruptError(
+                f"torn manifest for step {step} in {self.directory}: {e}"
+            ) from e
+
+    def _verify(self, step: int, payload) -> None:
+        """Recompute leaf digests and compare to the manifest; raises
+        CheckpointCorruptError on any mismatch."""
+        manifest = self._read_manifest(step)
+        if manifest is None:
+            return
+        t0 = time.perf_counter()
+        actual = _leaf_digests(payload)
+        core_telemetry.histogram("checkpoint.verify.latency").observe(
+            time.perf_counter() - t0)
+        expect = manifest["leaves"]
+        bad = [k for k in expect
+               if actual.get(k, {}).get("crc32") != expect[k]["crc32"]]
+        missing = [k for k in expect if k not in actual]
+        extra = [k for k in actual if k not in expect]
+        if bad or missing or extra:
+            core_telemetry.incr("checkpoint.corrupt")
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} in {self.directory} failed "
+                f"verification: {len(bad)} leaf checksum mismatches, "
+                f"{len(missing)} missing, {len(extra)} unexpected")
+
+    # -------------------------------------------------------- restore
+
     def restore(self, step: Optional[int] = None,
-                template: Optional[TrainState] = None) -> TrainState:
+                template: Optional[TrainState] = None,
+                verify: bool = True) -> TrainState:
         import orbax.checkpoint as ocp
 
-        step = self.latest_step() if step is None else int(step)
         if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        else:
+            # uniform missing-step error, independent of orbax internals
+            step = int(step)
+            if step not in self.all_steps():
+                raise FileNotFoundError(
+                    f"no checkpoint for step {step} in {self.directory}")
+        fault_point("checkpoint.read")
         if template is not None:
             target = {
                 "params": template.params,
@@ -81,6 +230,8 @@ class CheckpointManager:
         # resumed state onto ANY mesh (restoring committed single-device
         # arrays would conflict with jitted steps' input shardings)
         payload = jax.tree.map(lambda x: np.asarray(x), payload)
+        if verify:
+            self._verify(step, payload)
         return TrainState(
             params=payload["params"],
             batch_stats=payload["batch_stats"],
@@ -88,13 +239,41 @@ class CheckpointManager:
             step=int(np.asarray(payload["step"])),
         )
 
+    def restore_verified(self, template: Optional[TrainState] = None):
+        """Self-healing restore: walk checkpoints newest-first and return
+        ``(state, mgr_step)`` for the first that restores AND verifies.
+        Every corrupt/unreadable step walked past counts
+        ``checkpoint.fallback``; raises FileNotFoundError when no
+        checkpoint survives (caller decides: fresh start or abort).
+
+        Catches Exception only — an InjectedCrash (BaseException) still
+        kills the process, as a real SIGKILL would."""
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        for step in reversed(steps):
+            try:
+                return self.restore(step=step, template=template), step
+            except CheckpointCorruptError:
+                # _read_manifest/_verify already counted checkpoint.corrupt
+                core_telemetry.incr("checkpoint.fallback")
+            except Exception:
+                # orbax read errors, injected checkpoint.read faults: this
+                # step is not trustworthy either — keep walking back
+                core_telemetry.incr("checkpoint.corrupt")
+                core_telemetry.incr("checkpoint.fallback")
+        raise FileNotFoundError(
+            f"no checkpoint in {self.directory} passed verification "
+            f"(tried {len(steps)} steps)")
+
     def close(self):
         self._mgr.close()
 
 
 def save_checkpoint(directory: str, state: TrainState,
-                    step: Optional[int] = None) -> int:
-    mgr = CheckpointManager(directory)
+                    step: Optional[int] = None,
+                    max_to_keep: int = 3) -> int:
+    mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
     try:
         return mgr.save(state, step)
     finally:
@@ -103,16 +282,17 @@ def save_checkpoint(directory: str, state: TrainState,
 
 def restore_checkpoint(directory: str,
                        template: Optional[TrainState] = None,
-                       step: Optional[int] = None) -> TrainState:
-    mgr = CheckpointManager(directory)
+                       step: Optional[int] = None,
+                       max_to_keep: int = 3) -> TrainState:
+    mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
     try:
         return mgr.restore(step, template)
     finally:
         mgr.close()
 
 
-def latest_step(directory: str) -> Optional[int]:
-    mgr = CheckpointManager(directory)
+def latest_step(directory: str, max_to_keep: int = 3) -> Optional[int]:
+    mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
     try:
         return mgr.latest_step()
     finally:

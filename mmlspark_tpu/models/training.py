@@ -7,7 +7,10 @@ handled by XLA from sharding annotations.  bfloat16 compute, float32 state.
 """
 from __future__ import annotations
 
+import math
+import os
 import time
+import warnings
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -110,9 +113,14 @@ def _step_body(model, optimizer, num_classes, seed: int = 0):
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+        # global grad-norm rides along as a health probe for the training
+        # guard (models/guard.py): one scalar the step computes anyway-ish
+        # (same reduction tree XLA fuses into the update), so non-finite
+        # gradients are detectable without an extra dispatch
         return (
             TrainState(new_params, new_stats, new_opt, state.step + 1),
-            {"loss": loss, "accuracy": acc},
+            {"loss": loss, "accuracy": acc,
+             "grad_norm": optax.global_norm(grads)},
         )
 
     return step
@@ -369,6 +377,28 @@ def fit_epochs(
     return state, metrics
 
 
+def _autosave(mgr, state: TrainState, g: int) -> bool:
+    """Best-effort checkpoint write: a failed save must not kill a healthy
+    run (the previous checkpoint still covers resume) — warn, count
+    ``checkpoint.write_failed``, keep training.  An InjectedCrash
+    (BaseException) still propagates: that simulates process death, not a
+    write error."""
+    try:
+        if g in mgr.all_steps():
+            # a rollback replay re-reached a previously saved step: the
+            # replayed trajectory (new lr_scale, quarantine skips)
+            # supersedes the old bytes
+            mgr.delete(g)
+        mgr.save(state, step=g, wait=True)
+        core_telemetry.incr("training.autosave")
+        return True
+    except Exception as e:
+        core_telemetry.incr("checkpoint.write_failed")
+        warnings.warn(f"checkpoint write failed at step {g}: {e!r}",
+                      RuntimeWarning, stacklevel=2)
+        return False
+
+
 def fit_epochs_resumable(
     step_fn,
     state: TrainState,
@@ -382,11 +412,13 @@ def fit_epochs_resumable(
     mesh: Optional[Mesh] = None,
     seed: int = 0,
     log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    guard=None,
+    step_factory: Optional[Callable[[float], Callable]] = None,
 ) -> Tuple[TrainState, Dict[str, float]]:
     """fit_epochs that survives being killed: auto-checkpoints every
     `checkpoint_every` steps through CheckpointManager and, on the next
     call with the same `checkpoint_dir`, resumes from the latest
-    checkpoint — reproducing the uninterrupted run EXACTLY.
+    *verified* checkpoint — reproducing the uninterrupted run EXACTLY.
 
     Exactness rests on two invariants:
 
@@ -401,13 +433,37 @@ def fit_epochs_resumable(
 
     The loop runs per-step (the scanned epoch_fn path would quantize
     checkpoints to epoch boundaries) and crosses `fault_point
-    ("training.step")` each step so chaos tests can kill it mid-epoch.
-    Telemetry: ``training.autosave`` per checkpoint written,
-    ``training.resume`` when a run starts from a restored step."""
+    ("training.step")` each executed step so chaos tests can kill it
+    mid-epoch.  Checkpoints are numbered by **schedule position** (the
+    global batch index ``g``), which equals ``state.step`` until a guard
+    quarantine skips a batch — resume always continues the schedule, not
+    the optimizer count.
+
+    With a :class:`~mmlspark_tpu.models.guard.TrainingGuard` passed as
+    ``guard``, every step's (loss, grad_norm) probes feed the anomaly
+    ladder (docs/robustness.md "Training reliability ladder"): anomalous
+    batches are quarantined (skipped on replay, persisted to
+    ``quarantine.json`` in `checkpoint_dir`), the loop rolls back to the
+    newest checkpoint that passes integrity verification, and the run
+    aborts with :class:`~mmlspark_tpu.models.guard.TrainingAborted` once
+    the guard's rollback budget is spent.  ``step_factory(lr_scale)``
+    (optional) rebuilds the jitted step after each rollback so the
+    guard's LR backoff actually reaches the optimizer; without it the
+    rollback still replays cleanly at the original LR.  The fault points
+    ``training.loss_nan`` / ``training.grad_nan`` poison a step's batch /
+    gradient probe deterministically for chaos tests
+    (tools/train_soak.py).
+
+    Telemetry: ``training.autosave`` per checkpoint written (best-effort:
+    a failed write warns + counts ``checkpoint.write_failed`` instead of
+    killing the run), ``training.resume`` when a run starts from a
+    restored step, plus the guard's ``training.anomaly/quarantine/
+    rollback/abort/hang`` ledger."""
     from ..io.feed import DeviceFeed
-    from ..utils.faults import fault_point
+    from ..utils.faults import InjectedFault, fault_point
     # lazy: checkpoint.py imports TrainState from this module
     from .checkpoint import CheckpointManager
+    from .guard import GuardAction, TrainingAborted
 
     if checkpoint_every < 1:
         raise ValueError(
@@ -422,49 +478,140 @@ def fit_epochs_resumable(
     if steps_per_epoch < 1:
         raise ValueError(
             f"dataset has {n} rows < batch_size {batch_size}; lower batch_size")
+    if step_fn is None:
+        if step_factory is None:
+            raise ValueError("need step_fn or step_factory")
+        step_fn = step_factory(guard.lr_scale if guard is not None else 1.0)
 
     mgr = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+    qpath = os.path.join(os.fspath(checkpoint_dir), "quarantine.json")
+    own_guard = guard is not None and not guard.running
+    if own_guard:
+        guard.start()
     try:
+        if guard is not None:
+            guard.load_quarantine(qpath)
         latest = mgr.latest_step()
+        g = int(state.step)
         if latest is not None and latest > int(state.step):
-            state = mgr.restore(step=latest, template=state)
-            core_telemetry.incr("training.resume")
-        start = int(state.step)
+            try:
+                # self-healing resume: newest checkpoint that VERIFIES
+                # (corrupt ones are walked past, counting
+                # checkpoint.corrupt/fallback)
+                state, g = mgr.restore_verified(template=state)
+                core_telemetry.incr("training.resume")
+            except FileNotFoundError:
+                # every checkpoint corrupt: start fresh rather than die
+                g = int(state.step)
+        g0 = g
         total = epochs * steps_per_epoch
+        if guard is not None and total > g and mgr.latest_step() is None:
+            # floor checkpoint: the ladder's rollback target must exist
+            # before the first anomaly can need it
+            _autosave(mgr, state, g)
         feed = DeviceFeed(mesh=mesh)
         img_sh = batch_sharding(mesh, np.ndim(images))
         lbl_sh = batch_sharding(mesh, np.ndim(labels))
         metrics: Dict[str, float] = {}
         order = None
         order_epoch = -1
-        for g in range(start, total):
+        while g < total:
             epoch, b = divmod(g, steps_per_epoch)
             if epoch != order_epoch:
                 # schedule is (seed, epoch)-pure: resume regenerates it
                 order = np.random.default_rng([seed, epoch]).permutation(n)
                 order_epoch = epoch
+            if guard is not None and g in guard.quarantined:
+                # a batch the ladder already condemned: skip on replay
+                # (the optimizer count no longer advances for it — that
+                # is why checkpoints are numbered by g, not state.step)
+                core_telemetry.incr("training.quarantine.skip")
+                g += 1
+                if g % checkpoint_every == 0:
+                    _autosave(mgr, state, g)
+                continue
             fault_point("training.step")
+            poison_loss = poison_grad = False
+            try:
+                fault_point("training.loss_nan")
+            except InjectedFault:
+                poison_loss = True
+            try:
+                fault_point("training.grad_nan")
+            except InjectedFault:
+                poison_grad = True
             idx = order[b * batch_size:(b + 1) * batch_size]
-            dbi, dbl = feed.put_group([images[idx], labels[idx]],
+            xb, yb = images[idx], labels[idx]
+            if poison_loss and np.issubdtype(xb.dtype, np.floating):
+                # a genuinely poisoned batch: NaN data → NaN loss → NaN
+                # grads, end to end through the real jitted step
+                xb = np.full_like(xb, np.nan)
+            dbi, dbl = feed.put_group([xb, yb],
                                       shardings=(img_sh, lbl_sh))
             t0 = time.perf_counter()
             with core_telemetry.span("training.step"):
-                state, m = step_fn(state, dbi, dbl)
-                metrics = {k: float(v) for k, v in m.items()}
+                if guard is not None:
+                    guard.step_begin(g)
+                try:
+                    new_state, m = step_fn(state, dbi, dbl)
+                    metrics = {k: float(v) for k, v in m.items()}
+                finally:
+                    if guard is not None:
+                        guard.step_end()
             dt = time.perf_counter() - t0
             core_telemetry.histogram(
                 "models.training.step_latency").observe(dt)
             core_telemetry.gauge("models.training.examples_per_sec").set(
                 batch_size / dt if dt > 0 else 0.0)
+            action = GuardAction.OK
+            if guard is not None:
+                loss = metrics.get("loss", float("nan"))
+                if poison_loss and math.isfinite(loss):
+                    # integer-input models can't carry NaN through the
+                    # batch; poison the probe itself instead
+                    loss = float("nan")
+                grad_norm = metrics.get("grad_norm")
+                if poison_grad:
+                    grad_norm = float("nan")
+                action = guard.observe(g, loss, grad_norm)
+            if action == GuardAction.ABORT:
+                guard.save_quarantine(qpath)
+                raise TrainingAborted(
+                    f"guard exhausted its rollback budget "
+                    f"({guard.max_rollbacks}) at schedule step {g}; "
+                    f"quarantined={sorted(map(repr, guard.quarantined))}")
+            if action == GuardAction.ROLLBACK:
+                # persist the verdict BEFORE restoring: a crash here must
+                # not forget which batch was poisoned
+                guard.save_quarantine(qpath)
+                with core_telemetry.span("training.guard.rollback") as sp:
+                    try:
+                        # new_state (not the donated pre-step state) is
+                        # the only guaranteed-alive template
+                        state, g = mgr.restore_verified(template=new_state)
+                    except FileNotFoundError as e:
+                        core_telemetry.incr("training.abort")
+                        raise TrainingAborted(
+                            f"rollback at schedule step {g} found no "
+                            f"verifiable checkpoint: {e}") from e
+                    sp.attrs["restored_step"] = g
+                    sp.attrs["lr_scale"] = guard.lr_scale
+                if step_factory is not None:
+                    step_fn = step_factory(guard.lr_scale)
+                continue
+            state = new_state
             if log_fn:
                 log_fn(int(state.step), metrics)
-            if int(state.step) % checkpoint_every == 0:
-                mgr.save(state, wait=True)
-                core_telemetry.incr("training.autosave")
-        if total > start and int(state.step) % checkpoint_every != 0:
-            mgr.save(state, wait=True)  # final state always resumable
-            core_telemetry.incr("training.autosave")
+            g += 1
+            if g % checkpoint_every == 0:
+                _autosave(mgr, state, g)
+        if total > g0 and g % checkpoint_every != 0:
+            _autosave(mgr, state, g)  # final state always resumable
+        if guard is not None and guard.quarantined:
+            guard.save_quarantine(qpath)
     finally:
+        if own_guard:
+            guard.stop()
         mgr.close()
     return state, metrics
 

@@ -7,7 +7,13 @@ failure paths testable on demand.  Production code declares **named fault
 points** (`fault_point("feed.device_put")`) at every site that can fail
 in the field — a transfer, a batch-loop tick, an HTTP send, a training
 step, a gateway forward or health probe (`fleet.forward`,
-`fleet.health` in serving/fleet.py).  By default a fault point is a no-op costing one attribute load and
+`fleet.health` in serving/fleet.py), a checkpoint write/read
+(`checkpoint.write`, `checkpoint.read` in models/checkpoint.py), or a
+poisoned training batch (`training.loss_nan`, `training.grad_nan` in
+models/training.py — these two are *converted* by the loop into NaN
+data / NaN gradient probes rather than raised, so they exercise the
+TrainingGuard quarantine→rollback ladder instead of the error path).
+By default a fault point is a no-op costing one attribute load and
 one branch.  Tests (and `tools/chaos_soak.py`) arm a seeded `FaultPlan`
 through the process-global injector:
 

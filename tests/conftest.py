@@ -59,12 +59,14 @@ def pytest_configure(config):
         "fast and tier-1 — chaos here means reproducible, not flaky")
 
 
-# thread-name prefixes owned by serving/batching infrastructure; a test
-# that returns while one of these is still alive has leaked a server or
-# batcher (a later test inherits its port contention / fault plan /
-# telemetry noise).  Only non-daemon threads fail the test outright:
-# daemon pool threads (ThreadPoolExecutor) park harmlessly.
-_INFRA_PREFIXES = ("serve-", "serving-", "continuous-batcher", "stream-")
+# thread-name prefixes owned by serving/batching/training infrastructure;
+# a test that returns while one of these is still alive has leaked a
+# server, batcher, or training-guard watchdog (a later test inherits its
+# port contention / fault plan / telemetry noise).  Only non-daemon
+# threads fail the test outright: daemon pool threads
+# (ThreadPoolExecutor) park harmlessly.
+_INFRA_PREFIXES = ("serve-", "serving-", "continuous-batcher", "stream-",
+                   "train-guard")
 
 
 @pytest.fixture(autouse=True)
@@ -85,9 +87,10 @@ def _no_leaked_serving_threads(request):
             return
         time.sleep(0.05)
     pytest.fail(
-        f"test leaked non-daemon serving threads: "
+        f"test leaked non-daemon infra threads: "
         f"{[t.name for t in leaked]} — call .stop() on every "
-        "WorkerServer/ServingServer/ContinuousBatcher the test starts")
+        "WorkerServer/ServingServer/ContinuousBatcher/TrainingGuard "
+        "the test starts")
 
 
 @pytest.fixture

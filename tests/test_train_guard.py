@@ -1,0 +1,400 @@
+"""Training reliability ladder tests (PR 10, docs/robustness.md):
+TrainingGuard detection/escalation, the hung-step watchdog, checkpoint
+integrity manifests + verified fallback restore, and the guarded
+fit_epochs_resumable loop under injected NaN batches.
+
+Everything chaos-marked here is deterministic — seeded injection,
+scripted nth indices (see docs/robustness.md "Writing a chaos test").
+"""
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import telemetry
+from mmlspark_tpu.models.guard import (GuardAction, TrainingAborted,
+                                       TrainingGuard)
+from mmlspark_tpu.utils.faults import FAULTS, FaultPlan
+
+
+def _counter(name):
+    return telemetry.counters().get(name, 0)
+
+
+# ----------------------------------------------------- guard: observe
+
+def test_guard_healthy_stream_is_silent():
+    g = TrainingGuard(watchdog=False, min_history=4)
+    for i in range(32):
+        assert g.observe(i, 1.0 + 0.01 * (i % 5)) == GuardAction.OK
+    assert not g.anomalies and not g.quarantined and g.rollbacks == 0
+    assert g.lr_scale == 1.0
+
+
+def test_guard_nonfinite_loss_quarantines_and_rolls_back():
+    g = TrainingGuard(watchdog=False)
+    before = _counter("training.rollback")
+    assert g.observe(7, float("nan")) == GuardAction.ROLLBACK
+    assert g.quarantined == {7}
+    assert g.rollbacks == 1 and g.lr_scale == 0.5
+    assert g.anomalies[-1]["kind"] == "loss_nonfinite"
+    assert _counter("training.rollback") == before + 1
+
+
+def test_guard_nonfinite_grad_detected_separately():
+    g = TrainingGuard(watchdog=False)
+    assert g.observe(3, 0.5, float("inf")) == GuardAction.ROLLBACK
+    assert g.anomalies[-1]["kind"] == "grad_nonfinite"
+    assert g.quarantined == {3}
+
+
+def test_guard_spike_records_then_escalates_on_patience():
+    g = TrainingGuard(watchdog=False, min_history=8, window=16,
+                      spike_mads=6.0, spike_floor=0.1, spike_patience=3)
+    for i in range(8):
+        g.observe(i, 1.0)
+    # two consecutive spikes: recorded, not yet escalated
+    assert g.observe(100, 50.0) == GuardAction.RECORD
+    assert g.observe(101, 50.0) == GuardAction.RECORD
+    assert not g.quarantined
+    # third consecutive spike hits patience: quarantine + rollback
+    assert g.observe(102, 50.0) == GuardAction.ROLLBACK
+    assert g.quarantined == {102}
+    # a healthy step resets the streak
+    g2 = TrainingGuard(watchdog=False, min_history=8, spike_patience=2)
+    for i in range(8):
+        g2.observe(i, 1.0)
+    assert g2.observe(50, 99.0) == GuardAction.RECORD
+    assert g2.observe(51, 1.0) == GuardAction.OK
+    assert g2.observe(52, 99.0) == GuardAction.RECORD  # streak restarted
+    assert not g2.quarantined
+
+
+def test_guard_aborts_after_rollback_budget():
+    g = TrainingGuard(watchdog=False, max_rollbacks=2)
+    before = _counter("training.abort")
+    assert g.observe(0, float("nan")) == GuardAction.ROLLBACK
+    assert g.observe(1, float("nan")) == GuardAction.ROLLBACK
+    assert g.observe(2, float("nan")) == GuardAction.ABORT
+    assert g.lr_scale == 0.25  # two backoffs, aborted before a third
+    assert _counter("training.abort") == before + 1
+
+
+def test_guard_quarantine_persists_atomically(tmp_path):
+    g = TrainingGuard(watchdog=False)
+    g.quarantined = {3, 11, (2, 5)}
+    path = tmp_path / "q.json"
+    g.save_quarantine(path)
+    g2 = TrainingGuard(watchdog=False)
+    g2.load_quarantine(path)
+    assert g2.quarantined == {3, 11, (2, 5)}
+    # torn/missing files are a no-op, never a crash
+    path.write_text("{not json")
+    g3 = TrainingGuard(watchdog=False)
+    g3.load_quarantine(path)
+    g3.load_quarantine(tmp_path / "absent.json")
+    assert g3.quarantined == set()
+
+
+# ---------------------------------------------------- guard: watchdog
+
+def test_watchdog_fires_on_hung_step_and_joins():
+    g = TrainingGuard(hang_timeout_s=0.15)
+    before = _counter("training.hang")
+    with g:
+        g.step_begin(42)
+        time.sleep(0.5)          # "hung" well past the budget
+        g.step_end()
+        g.step_begin(43)         # healthy step: no second alarm
+        g.step_end()
+        time.sleep(0.2)
+    assert g.hangs == 1          # latched: one alarm per hung step
+    assert _counter("training.hang") == before + 1
+    assert not g.running         # joined — conftest leak check agrees
+
+
+def test_watchdog_budget_derives_from_step_latency_p95():
+    h = telemetry.histogram("models.training.step_latency")
+    for _ in range(50):
+        h.observe(0.02)
+    g = TrainingGuard(watchdog=False, hang_multiplier=20.0, hang_min_s=0.1)
+    p95 = h.percentile(0.95)
+    assert g.hang_budget_s() == pytest.approx(max(0.1, 20.0 * p95))
+    assert TrainingGuard(watchdog=False,
+                         hang_timeout_s=9.0).hang_budget_s() == 9.0
+
+
+# ------------------------------------------- checkpoint: helpers/mgr
+
+@pytest.fixture(scope="module")
+def tiny_train():
+    """One compiled step + init shared by every integration test here."""
+    import flax.linen as nn
+    import optax
+
+    from mmlspark_tpu.models.training import (init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x), {}
+
+    model, opt = M(), optax.sgd(0.1)
+    mesh = default_mesh()
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(64, 4, 4, 1)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=64)
+    step = make_train_step(model, opt, 4, mesh=mesh, donate=False)
+
+    def fresh():
+        return init_train_state(model, opt, (4, 4, 1), seed=0)
+
+    return dict(model=model, opt=opt, mesh=mesh, imgs=imgs, lbls=lbls,
+                step=step, fresh=fresh)
+
+
+def test_explicit_missing_step_raises_uniform_error(tmp_path, tiny_train):
+    from mmlspark_tpu.models.checkpoint import (CheckpointManager,
+                                                restore_checkpoint)
+
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    try:
+        mgr.save(tiny_train["fresh"](), step=1)
+        with pytest.raises(FileNotFoundError, match="step 99"):
+            mgr.restore(step=99)
+    finally:
+        mgr.close()
+    with pytest.raises(FileNotFoundError, match="step 99"):
+        restore_checkpoint(str(tmp_path), step=99)
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path / "empty"))
+
+
+def test_module_helpers_thread_max_to_keep(tmp_path, tiny_train):
+    from mmlspark_tpu.models.checkpoint import (latest_step,
+                                                save_checkpoint)
+
+    state = tiny_train["fresh"]()
+    for s in (1, 2, 3):
+        save_checkpoint(str(tmp_path), state, step=s, max_to_keep=2)
+    assert latest_step(str(tmp_path), max_to_keep=2) == 3
+    kept = sorted(int(p.name) for p in tmp_path.iterdir()
+                  if p.name.isdigit())
+    assert kept == [2, 3]  # retention honored by the throwaway managers
+
+
+def test_save_writes_manifest_and_restore_verifies(tmp_path, tiny_train):
+    from mmlspark_tpu.models.checkpoint import (MANIFEST_NAME,
+                                                CheckpointManager)
+
+    state = tiny_train["fresh"]()
+    mgr = CheckpointManager(str(tmp_path))
+    try:
+        mgr.save(state, step=5)
+        manifest = tmp_path / "5" / MANIFEST_NAME
+        assert manifest.exists()
+        doc = json.loads(manifest.read_text())
+        assert doc["format"] == 1 and doc["leaves"]
+        before = telemetry.histogram(
+            "checkpoint.verify.latency").snapshot()["count"]
+        out = mgr.restore(step=5, template=state)
+        assert int(out.step) == int(state.step)
+        assert telemetry.histogram(
+            "checkpoint.verify.latency").snapshot()["count"] == before + 1
+    finally:
+        mgr.close()
+
+
+@pytest.mark.chaos
+def test_truncated_leaf_falls_back_to_older_verified_step(tmp_path,
+                                                          tiny_train):
+    """Truncate real checkpoint bytes (the primary ocdbt data file) of
+    the newest step: restore_verified must walk back to the older step
+    and count checkpoint.corrupt + checkpoint.fallback in the
+    exported snapshot."""
+    from mmlspark_tpu.models.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    try:
+        s = tiny_train["fresh"]()
+        mgr.save(s, step=1)
+        mgr.save(s, step=2)
+        victims = sorted(glob.glob(str(tmp_path / "2" / "default" / "d" /
+                                       "*")))
+        assert victims, "orbax layout changed: no data files under d/"
+        with open(victims[0], "r+b") as f:
+            f.truncate(max(0, os.path.getsize(victims[0]) // 2))
+        c0 = telemetry.export_snapshot()["counters"]
+        state, step = mgr.restore_verified(template=s)
+        c1 = telemetry.export_snapshot()["counters"]
+        assert step == 1 and int(state.step) == int(s.step)
+        assert c1.get("checkpoint.corrupt", 0) > c0.get(
+            "checkpoint.corrupt", 0)
+        assert c1.get("checkpoint.fallback", 0) > c0.get(
+            "checkpoint.fallback", 0)
+    finally:
+        mgr.close()
+
+
+@pytest.mark.chaos
+def test_flipped_manifest_byte_detected_and_fallback(tmp_path, tiny_train):
+    """Flip one checksum digit in the newest manifest: explicit restore
+    raises CheckpointCorruptError; restore_verified falls back."""
+    from mmlspark_tpu.models.checkpoint import (MANIFEST_NAME,
+                                                CheckpointCorruptError,
+                                                CheckpointManager)
+
+    mgr = CheckpointManager(str(tmp_path))
+    try:
+        s = tiny_train["fresh"]()
+        mgr.save(s, step=1)
+        mgr.save(s, step=2)
+        manifest = tmp_path / "2" / MANIFEST_NAME
+        doc = json.loads(manifest.read_text())
+        key = sorted(doc["leaves"])[0]
+        doc["leaves"][key]["crc32"] ^= 1
+        manifest.write_text(json.dumps(doc))
+        with pytest.raises(CheckpointCorruptError, match="mismatch"):
+            mgr.restore(step=2, template=s)
+        _, step = mgr.restore_verified(template=s)
+        assert step == 1
+        # a torn (unparseable) manifest is treated as corrupt too
+        manifest.write_text("{torn")
+        with pytest.raises(CheckpointCorruptError, match="torn"):
+            mgr.restore(step=2, template=s)
+        assert telemetry.export_snapshot()["counters"].get(
+            "checkpoint.corrupt", 0) >= 2
+    finally:
+        mgr.close()
+
+
+@pytest.mark.chaos
+def test_checkpoint_write_fault_is_best_effort(tmp_path, tiny_train):
+    """An injected checkpoint.write failure must not kill the run —
+    warn + checkpoint.write_failed, and the run stays resumable from
+    the previous good checkpoint."""
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+
+    t = tiny_train
+    before = _counter("checkpoint.write_failed")
+    plan = FaultPlan(seed=3).on("checkpoint.write", nth=[1])
+    with FAULTS.arm(plan):
+        with pytest.warns(RuntimeWarning, match="checkpoint write failed"):
+            state, _ = fit_epochs_resumable(
+                t["step"], t["fresh"](), t["imgs"], t["lbls"],
+                batch_size=16, checkpoint_dir=str(tmp_path), epochs=2,
+                checkpoint_every=4, mesh=t["mesh"], seed=7)
+        assert FAULTS.fires["checkpoint.write"] == 1
+    assert int(state.step) == 8
+    assert _counter("checkpoint.write_failed") == before + 1
+
+
+# -------------------------------------------- guarded loop end-to-end
+
+@pytest.mark.chaos
+def test_guarded_loop_quarantines_nan_batch_and_recovers(tmp_path,
+                                                         tiny_train):
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+
+    t = tiny_train
+    guard = TrainingGuard(hang_timeout_s=60.0)
+    before = {k: _counter(k) for k in
+              ("training.rollback", "training.quarantine")}
+    plan = FaultPlan(seed=5).on("training.loss_nan", nth=[5])
+    with FAULTS.arm(plan):
+        state, metrics = fit_epochs_resumable(
+            t["step"], t["fresh"](), t["imgs"], t["lbls"],
+            batch_size=16, checkpoint_dir=str(tmp_path), epochs=3,
+            checkpoint_every=4, mesh=t["mesh"], seed=7, guard=guard)
+        assert FAULTS.fires["training.loss_nan"] == 1
+    assert np.isfinite(metrics["loss"])
+    assert guard.quarantined == {5}          # crossing 5 == batch g=5
+    assert guard.rollbacks == 1
+    # schedule ran to the end minus the one quarantined batch
+    assert int(state.step) == 12 - 1
+    assert _counter("training.rollback") == before["training.rollback"] + 1
+    assert _counter("training.quarantine") == (
+        before["training.quarantine"] + 1)
+    q = json.loads((tmp_path / "quarantine.json").read_text())
+    assert q["quarantined"] == [5]
+    assert not guard.running                 # loop joined its watchdog
+
+
+@pytest.mark.chaos
+def test_guard_abort_raises_training_aborted(tmp_path, tiny_train):
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+
+    t = tiny_train
+    guard = TrainingGuard(max_rollbacks=1, hang_timeout_s=60.0)
+    plan = FaultPlan(seed=5).on("training.loss_nan", probability=1.0)
+    with FAULTS.arm(plan):
+        with pytest.raises(TrainingAborted, match="rollback budget"):
+            fit_epochs_resumable(
+                t["step"], t["fresh"](), t["imgs"], t["lbls"],
+                batch_size=16, checkpoint_dir=str(tmp_path), epochs=3,
+                checkpoint_every=4, mesh=t["mesh"], seed=7, guard=guard)
+    assert not guard.running
+
+
+@pytest.mark.chaos
+def test_guard_is_bitwise_passive_on_healthy_runs(tmp_path, tiny_train):
+    """The guard observes; it must never perturb the trajectory: a
+    guarded run is bit-identical to an unguarded one."""
+    import jax
+
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+
+    t = tiny_train
+    kw = dict(batch_size=16, epochs=2, checkpoint_every=4,
+              mesh=t["mesh"], seed=7)
+    plain, _ = fit_epochs_resumable(
+        t["step"], t["fresh"](), t["imgs"], t["lbls"],
+        checkpoint_dir=str(tmp_path / "plain"), **kw)
+    guard = TrainingGuard(hang_timeout_s=60.0)
+    guarded, _ = fit_epochs_resumable(
+        t["step"], t["fresh"](), t["imgs"], t["lbls"],
+        checkpoint_dir=str(tmp_path / "guarded"), guard=guard, **kw)
+    assert not guard.anomalies
+    assert all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(plain.params),
+                               jax.tree.leaves(guarded.params)))
+
+
+@pytest.mark.chaos
+def test_resume_walks_past_corrupted_checkpoint(tmp_path, tiny_train):
+    """Corrupt the newest checkpoint's manifest between kill and resume:
+    the loop self-heals from the older verified step, no intervention."""
+    from mmlspark_tpu.models.checkpoint import MANIFEST_NAME
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+    from mmlspark_tpu.utils.faults import InjectedCrash
+
+    t = tiny_train
+    kw = dict(batch_size=16, epochs=3, checkpoint_every=4,
+              mesh=t["mesh"], seed=7,
+              checkpoint_dir=str(tmp_path))
+    crash = FaultPlan(seed=1).on("training.step", nth=[9],
+                                 error=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        with FAULTS.arm(crash):
+            fit_epochs_resumable(t["step"], t["fresh"](), t["imgs"],
+                                 t["lbls"], **kw)
+    steps = sorted(int(p.name) for p in tmp_path.iterdir()
+                   if p.name.isdigit())
+    assert steps == [4, 8]
+    doc_path = tmp_path / "8" / MANIFEST_NAME
+    doc = json.loads(doc_path.read_text())
+    key = sorted(doc["leaves"])[0]
+    doc["leaves"][key]["crc32"] ^= 1
+    doc_path.write_text(json.dumps(doc))
+    fb0 = _counter("checkpoint.fallback")
+    state, metrics = fit_epochs_resumable(t["step"], t["fresh"](),
+                                          t["imgs"], t["lbls"], **kw)
+    assert int(state.step) == 12 and np.isfinite(metrics["loss"])
+    assert _counter("checkpoint.fallback") == fb0 + 1

@@ -326,10 +326,27 @@ def fleet_smoke(timeout_s: int = 300) -> int:
     return rc
 
 
+def train_smoke(timeout_s: int = 300) -> int:
+    """Run the training-reliability soak (tools/train_soak.py) as a
+    smoke job: seeded NaN batches + mid-epoch kill + on-disk checkpoint
+    corruption, survived with a bit-exact no-fault parity check.  CPU
+    backend so the job runs on any CI machine."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join("tools", "train_soak.py"), "--json"]
+    try:
+        rc = subprocess.call(cmd, cwd=ROOT, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"train-soak timed out after {timeout_s}s")
+        return 1
+    print("train-soak:", "OK" if rc == 0 else f"FAILED (rc={rc})")
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("command", choices=["lint", "metrics-lint", "test",
-                                        "perf-gate", "fleet-smoke", "all"])
+                                        "perf-gate", "fleet-smoke",
+                                        "train-soak", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
@@ -355,6 +372,8 @@ def main(argv=None):
         return perf_gate(args.fresh, args.against, args.scale)
     if args.command == "fleet-smoke":
         return fleet_smoke()
+    if args.command == "train-soak":
+        return train_smoke()
     if args.command == "test":
         return test(args.shards, args.shard, args.retries, args.timeout)
     rc = lint()

@@ -54,6 +54,10 @@ GATE_METRICS: Dict[str, Tuple[str, float, float]] = {
     "feed_transfer_calls": ("lower", 0.25, 2.0),
     # any steady-state recompile the warmed bench run never had is a bug
     "steady_recompiles": ("lower", 0.0, 0.0),
+    # the guard-disabled training loop's plumbing contract: <1% per-step
+    # overhead, absolute band (the base fraction hovers near zero, so a
+    # relative tolerance would be meaningless)
+    "guard_overhead_frac": ("lower", 0.0, 0.01),
 }
 
 

@@ -1,0 +1,297 @@
+"""Training-reliability soak: NaN batches + mid-epoch kill + checkpoint
+corruption, survived end-to-end with zero manual intervention.
+
+The training-side twin of tools/chaos_soak.py (serving) and
+tools/fleet_soak.py (gateway): a seeded, CPU-fast scenario script that
+drives `fit_epochs_resumable` under a `TrainingGuard` through every rung
+of the reliability ladder (docs/robustness.md "Training reliability
+ladder") and asserts the run ends healthy:
+
+* **Phase A — parity.**  With the guard attached but NO data faults, a
+  kill-and-resume run must stay **bit-for-bit identical** to an
+  uninterrupted reference: the guard observes, it never perturbs.
+* **Phase B — chaos.**  One injected NaN-data batch
+  (``training.loss_nan``), one injected NaN-gradient probe
+  (``training.grad_nan``), one `InjectedCrash` mid-epoch, and one
+  on-disk corruption of the newest checkpoint manifest before resume.
+  Asserts: the run completes with a finite final loss; the quarantined
+  set is exactly the injected-NaN batches (count == fires); each
+  anomaly rolled back to a verified checkpoint; resume fell back past
+  the corrupted step (``checkpoint.corrupt``/``checkpoint.fallback``);
+  and total reprocessing stayed bounded (crossings of
+  ``training.step`` ≤ schedule + rollback/kill replay windows).
+
+Runs entirely on the virtual CPU mesh (tools/ci.py `train-soak` smoke).
+Exit code 0 ⇒ every invariant held.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+# schedule geometry shared by both phases (mirrors the pinned
+# kill-and-resume chaos test: 64 rows / batch 16 / 3 epochs = 12 steps)
+N_ROWS, BATCH, EPOCHS, CKPT_EVERY = 64, 16, 3, 4
+TOTAL_STEPS = EPOCHS * (N_ROWS // BATCH)
+
+
+def _setup(lr: float = 0.1):
+    """Tiny model + data + step factory; one compile per lr scale."""
+    import flax.linen as nn
+    import optax
+
+    from mmlspark_tpu.models.training import (init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x), {}
+
+    model = M()
+    mesh = default_mesh()
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(N_ROWS, 4, 4, 1)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=N_ROWS)
+
+    def step_factory(lr_scale):
+        return make_train_step(model, optax.sgd(lr * lr_scale), 4,
+                               mesh=mesh, donate=False)
+
+    def fresh():
+        return init_train_state(model, optax.sgd(lr), (4, 4, 1), seed=0)
+
+    return mesh, imgs, lbls, step_factory, fresh
+
+
+def _fit(step_factory, fresh_state, imgs, lbls, mesh, ckpt_dir, guard,
+         seed):
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+
+    return fit_epochs_resumable(
+        None, fresh_state, imgs, lbls, batch_size=BATCH,
+        checkpoint_dir=str(ckpt_dir), epochs=EPOCHS,
+        checkpoint_every=CKPT_EVERY, mesh=mesh, seed=seed,
+        guard=guard, step_factory=step_factory)
+
+
+def _params_equal(a, b):
+    import jax
+
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a.params),
+                               jax.tree.leaves(b.params)))
+
+
+def run_parity(workdir, seed: int = 7) -> dict:
+    """Guard attached, no data faults: kill-and-resume stays bit-exact
+    and the guard records nothing."""
+    from mmlspark_tpu.models.guard import TrainingGuard
+    from mmlspark_tpu.utils.faults import FAULTS, FaultPlan, InjectedCrash
+
+    mesh, imgs, lbls, step_factory, fresh = _setup()
+    ref_guard = TrainingGuard()
+    ref, _ = _fit(step_factory, fresh(), imgs, lbls, mesh,
+                  Path(workdir) / "ref", ref_guard, seed)
+
+    kill_dir = Path(workdir) / "kill"
+    crash = FaultPlan(seed=1).on("training.step", nth=[6],
+                                 error=InjectedCrash)
+    died = False
+    try:
+        with FAULTS.arm(crash):
+            _fit(step_factory, fresh(), imgs, lbls, mesh, kill_dir,
+                 TrainingGuard(), seed)
+    except InjectedCrash:
+        died = True
+    assert died, "the scripted mid-epoch kill never fired"
+
+    res_guard = TrainingGuard()
+    res, metrics = _fit(step_factory, fresh(), imgs, lbls, mesh,
+                        kill_dir, res_guard, seed)
+    assert int(ref.step) == int(res.step) == TOTAL_STEPS, (
+        f"steps {int(ref.step)} vs {int(res.step)} != {TOTAL_STEPS}")
+    assert _params_equal(ref, res), (
+        "guarded kill-and-resume diverged bit-for-bit from the "
+        "uninterrupted reference")
+    assert not ref_guard.anomalies and not res_guard.anomalies, (
+        "guard flagged anomalies on a healthy run")
+    assert not (kill_dir / "quarantine.json").exists(), (
+        "healthy run wrote a quarantine file")
+    return {"parity_bit_exact": True, "final_loss": metrics["loss"],
+            "steps": int(res.step)}
+
+
+def _corrupt_newest_manifest(ckpt_dir) -> int:
+    """Flip one checksum digit in the newest step's manifest — the
+    on-disk corruption a verify-on-restore must catch."""
+    from mmlspark_tpu.models.checkpoint import MANIFEST_NAME
+
+    manifests = sorted(glob.glob(str(Path(ckpt_dir) / "*" / MANIFEST_NAME)),
+                       key=lambda p: int(Path(p).parent.name))
+    assert manifests, f"no manifests under {ckpt_dir}"
+    victim = manifests[-1]
+    doc = json.loads(Path(victim).read_text())
+    key = sorted(doc["leaves"])[0]
+    doc["leaves"][key]["crc32"] = (doc["leaves"][key]["crc32"] + 1) % (2**32)
+    Path(victim).write_text(json.dumps(doc))
+    return int(Path(victim).parent.name)
+
+
+def run_chaos(workdir, seed: int = 7) -> dict:
+    """NaN batch + NaN grad + kill + manifest corruption, all survived."""
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.models.guard import TrainingGuard
+    from mmlspark_tpu.utils.faults import FAULTS, FaultPlan, InjectedCrash
+
+    mesh, imgs, lbls, step_factory, fresh = _setup()
+    ckpt_dir = Path(workdir) / "chaos"
+    c0 = dict(telemetry.counters())
+
+    # nth counts CROSSINGS of each point (replayed steps re-cross), so
+    # these indices are executed-step indices, not schedule positions:
+    # crossing 2 poisons batch g=2, crossing 5 lands on g=3 after the
+    # first rollback's replay, crossing 9 kills mid-epoch after the
+    # second rollback
+    plan = (FaultPlan(seed=seed)
+            .on("training.loss_nan", nth=[2])
+            .on("training.grad_nan", nth=[5])
+            .on("training.step", nth=[9], error=InjectedCrash))
+    guard = TrainingGuard(max_rollbacks=4)
+    died = False
+    try:
+        with FAULTS.arm(plan):
+            _fit(step_factory, fresh(), imgs, lbls, mesh, ckpt_dir,
+                 guard, seed)
+    except InjectedCrash:
+        died = True
+    crossings_before_kill = dict(FAULTS.calls)
+    nan_fires = (FAULTS.fires.get("training.loss_nan", 0)
+                 + FAULTS.fires.get("training.grad_nan", 0))
+    assert died, "the scripted kill never fired"
+    assert nan_fires == 2, f"expected 2 NaN injections, got {nan_fires}"
+    assert guard.rollbacks == 2, (
+        f"expected 2 rollbacks before the kill, got {guard.rollbacks}")
+    assert len(guard.quarantined) == nan_fires, (
+        f"quarantined {sorted(guard.quarantined)} != {nan_fires} "
+        "injected-NaN batches")
+    assert (ckpt_dir / "quarantine.json").exists(), (
+        "quarantine set not persisted before the kill")
+
+    corrupted_step = _corrupt_newest_manifest(ckpt_dir)
+
+    # resume: no faults fire — must walk past the corrupted checkpoint
+    # to an older verified one, honor the persisted quarantine, and
+    # finish with zero manual intervention.  (probability=0.0 arms a
+    # never-firing rule purely so FAULTS.calls keeps counting step
+    # crossings for the reprocessing bound.)
+    guard2 = TrainingGuard(max_rollbacks=4)
+    track = FaultPlan(seed=seed).on("training.step", probability=0.0)
+    with FAULTS.arm(track):
+        state, metrics = _fit(step_factory, fresh(), imgs, lbls, mesh,
+                              ckpt_dir, guard2, seed)
+    c1 = dict(telemetry.counters())
+
+    def delta(name):
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    assert np.isfinite(metrics["loss"]), (
+        f"final loss not finite: {metrics['loss']}")
+    assert sorted(guard2.quarantined) == sorted(guard.quarantined), (
+        "resume did not reload the persisted quarantine set")
+    assert int(state.step) == TOTAL_STEPS - len(guard.quarantined), (
+        f"optimizer steps {int(state.step)} != schedule {TOTAL_STEPS} "
+        f"minus {len(guard.quarantined)} quarantined")
+    assert delta("training.resume") == 1, "resume counter missing"
+    assert delta("checkpoint.corrupt") >= 1, (
+        "manifest corruption never detected")
+    assert delta("checkpoint.fallback") >= 1, (
+        "restore never fell back past the corrupted step")
+    assert delta("training.rollback") == 2 and delta(
+        "training.quarantine") == 2, "ladder counters off"
+    # bounded reprocessing: every replay window is at most
+    # checkpoint_every steps per rollback/kill/resume event
+    replay_events = guard.rollbacks + 1 + 1   # rollbacks + kill + fallback
+    crossings = (crossings_before_kill.get("training.step", 0)
+                 + FAULTS.calls.get("training.step", 0))
+    bound = TOTAL_STEPS + replay_events * (CKPT_EVERY + 2)
+    assert crossings <= bound, (
+        f"reprocessed too much: {crossings} step crossings > {bound}")
+    return {
+        "final_loss": metrics["loss"],
+        "quarantined": sorted(guard2.quarantined),
+        "rollbacks": guard.rollbacks,
+        "corrupted_step": corrupted_step,
+        "resumed_past_corruption": True,
+        "step_crossings": crossings,
+        "crossing_bound": bound,
+        "counters": {k: delta(k) for k in (
+            "training.rollback", "training.quarantine", "training.resume",
+            "training.anomaly", "checkpoint.corrupt",
+            "checkpoint.fallback", "training.autosave")},
+    }
+
+
+def write_obs_snapshot(path) -> str:
+    """Dump the observability snapshot with every declared `training.*` /
+    `checkpoint.*` counter present (zero-filled when untouched), so soak
+    assertions read one uniform shape — shared with chaos_soak."""
+    from chaos_soak import write_obs_snapshot as _write
+
+    return _write(path)
+
+
+def main(argv=None):
+    import tempfile
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--workdir", default=None,
+                    help="checkpoint scratch dir (default: a tempdir)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON object")
+    ap.add_argument("--obs-out", metavar="PATH", default=None,
+                    help="write the full observability snapshot to PATH "
+                         "for tools/obs_report.py")
+    args = ap.parse_args(argv)
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as tmp:
+        work = args.workdir or tmp
+        parity = run_parity(Path(work) / "parity", seed=args.seed)
+        chaos = run_chaos(Path(work) / "chaos", seed=args.seed)
+    summary = {"parity": parity, "chaos": chaos,
+               "wall_s": round(time.monotonic() - t0, 2)}
+    if args.obs_out:
+        write_obs_snapshot(args.obs_out)
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(f"train soak OK: parity bit-exact over {parity['steps']} "
+              f"steps; chaos run quarantined "
+              f"{chaos['quarantined']}, rolled back "
+              f"{chaos['rollbacks']}x, resumed past corrupted step "
+              f"{chaos['corrupted_step']}, final loss "
+              f"{chaos['final_loss']:.4f} "
+              f"({chaos['step_crossings']}/{chaos['crossing_bound']} "
+              f"step crossings) in {summary['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
