@@ -83,8 +83,9 @@ def client(prompt):
 
 
 try:
-    threads = [threading.Thread(target=client, args=(p,), daemon=True)
-               for p in WANT]
+    threads = [threading.Thread(target=client, args=(p,), daemon=True,
+                                name=f"example-lm-client-{i}")
+               for i, p in enumerate(WANT)]
     for t in threads:
         t.start()
     for t in threads:

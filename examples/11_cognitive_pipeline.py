@@ -88,7 +88,8 @@ def ocr_text(row):
 
 def main():
     srv = HTTPServer(("127.0.0.1", 0), _Mock)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="example-mock-http").start()
     base = f"http://127.0.0.1:{srv.server_address[1]}"
 
     imgs = np.empty(len(DOCS), dtype=object)

@@ -77,7 +77,8 @@ class _MockSearch(BaseHTTPRequestHandler):
 
 def main():
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _MockSearch)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="example-mock-search").start()
     base = f"http://127.0.0.1:{srv.server_port}"
 
     ids, titles, artists, descs = (list(c) for c in zip(*ARTWORKS))

@@ -12,6 +12,8 @@ import threading
 import time
 from typing import Iterable, Iterator, List, Optional, TypeVar
 
+from .telemetry import gauge
+
 T = TypeVar("T")
 
 __all__ = [
@@ -41,7 +43,8 @@ def buffered_prefetch(it: Iterable[T], buffer_size: int = 2) -> Iterator[T]:
         finally:
             q.put(sentinel)
 
-    threading.Thread(target=run, daemon=True).start()
+    threading.Thread(target=run, daemon=True,
+                     name="stream-iter-producer").start()
     while True:
         item = q.get()
         if item is sentinel:
@@ -88,13 +91,18 @@ class _BufferedBatcherBase:
             finally:
                 self._q.put(self._SENTINEL)
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="stream-batch-prefetch")
         self._thread.start()
 
     def __iter__(self):
         self._mark_consumed()
         while True:
             item = self._q.get()
+            # depth after the take: >0 sustained means the producer is
+            # running ahead (prefetch working); pinned at 0 means the
+            # consumer is starved
+            gauge("core.batching.queue.depth").set(self._q.qsize())
             if item is self._SENTINEL:
                 if self._err is not None:
                     raise self._err

@@ -89,7 +89,9 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.feed.degraded_engines": "gauge",
     "io.feed.overlap_frac": "gauge",
     "io.feed.stall_s": "gauge",
+    "io.feed.queue.depth": "gauge",
     "io.pipeline.queue.depth": "gauge",   # + .<stage> variants
+    "core.batching.queue.depth": "gauge",
     "models.training.examples_per_sec": "gauge",
     "training.guard.lr_scale": "gauge",
     "device.hbm.bytes_in_use": "gauge",
@@ -127,7 +129,7 @@ class Gauge:
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  #: guarded-by self._lock
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -247,13 +249,14 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
-        self._gauges: Dict[str, Gauge] = {}
+        self._counters: Dict[str, int] = {}  #: guarded-by self._lock
+        self._gauges: Dict[str, Gauge] = {}  #: guarded-by self._lock
+        #: guarded-by self._lock
         self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                           Histogram] = {}
         # the bucket ladder is fixed per NAME: every labeled child of
         # one histogram family must be mergeable/comparable
-        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}  #: guarded-by self._lock
 
     # ---- counters ------------------------------------------------------
     def incr(self, name: str, n: int = 1) -> None:

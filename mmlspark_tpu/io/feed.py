@@ -123,6 +123,8 @@ class _IterSource(FeedSource):
         try:
             for item in self._it:
                 self._q.put(item)
+                core_telemetry.gauge("io.feed.queue.depth").set(
+                    self._q.qsize())
         except BaseException as e:  # noqa: BLE001 — forwarded to consumer
             self._err.append(e)
         finally:

@@ -86,7 +86,7 @@ class PipelineTelemetry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._stages: Dict[str, Dict[str, float]] = {}
+        self._stages: Dict[str, Dict[str, float]] = {}  #: guarded-by self._lock
 
     def add(self, stage: str, busy_s: float = 0.0, items: int = 0):
         with self._lock:
@@ -148,10 +148,10 @@ class _Reorder:
     def __init__(self, put: Callable[[Any], None]):
         self._put = put
         self._lock = threading.Lock()
-        self._pending: Dict[int, Any] = {}
-        self._next = 0
-        self._total: Optional[int] = None
-        self._eof_sent = False
+        self._pending: Dict[int, Any] = {}  #: guarded-by self._lock
+        self._next = 0  #: guarded-by self._lock
+        self._total: Optional[int] = None  #: guarded-by self._lock
+        self._eof_sent = False  #: guarded-by self._lock
 
     def emit(self, seq: int, value: Any):
         with self._lock:
@@ -203,7 +203,10 @@ class HostPipeline:
         self._cancelled = threading.Event()
         self._err_lock = threading.Lock()
         self._error: Optional[BaseException] = None
-        self._high_water: Dict[str, int] = {}
+        # every stage worker and the producer race through _q_put; the
+        # read-modify-write max-merge below needs its own (tiny) lock
+        self._hw_lock = threading.Lock()
+        self._high_water: Dict[str, int] = {}  #: guarded-by self._hw_lock
         self._started = False
         self._ctx = None  # (trace_id, span_id) captured at start
 
@@ -245,7 +248,15 @@ class HostPipeline:
         queue feeds, plus 'out') — the structural overlap witness: a
         stage queue that reached depth >= 2 had the previous stage
         running ahead while this one was still busy."""
-        return dict(self._high_water)
+        with self._hw_lock:
+            return dict(self._high_water)
+
+    def _note_depth(self, name: str, depth: int) -> None:
+        """Max-merge one depth observation; lost updates here would
+        under-report overlap and silently pass the structural check."""
+        with self._hw_lock:
+            if depth > self._high_water.get(name, 0):
+                self._high_water[name] = depth
 
     # ---- queue plumbing ------------------------------------------------
     def _q_put(self, idx: int, item: Any):
@@ -258,8 +269,7 @@ class HostPipeline:
             except queue.Full:
                 continue
         depth = q.qsize()
-        if depth > self._high_water.get(name, 0):
-            self._high_water[name] = depth
+        self._note_depth(name, depth)
         core_telemetry.gauge(f"io.pipeline.queue.depth.{name}").set(depth)
 
     def _fail(self, e: BaseException):

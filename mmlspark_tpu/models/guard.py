@@ -125,18 +125,19 @@ class TrainingGuard:
         self.rollbacks = 0
         self.lr_scale = 1.0
         self.anomalies: List[Dict] = []
-        self.hangs = 0
 
         # watchdog heartbeat: a monotonically increasing step sequence
         # plus a begin timestamp; the reported-latch keeps one hung step
-        # from firing the alarm every poll tick
+        # from firing the alarm every poll tick.  Everything the
+        # watchdog thread and the training thread both touch is guarded.
         self._lock = threading.Lock()
         self._wd_thread: Optional[threading.Thread] = None
         self._wd_stop = threading.Event()
-        self._hb_seq = 0
-        self._hb_begin: Optional[float] = None
-        self._hb_batch: Optional[BatchId] = None
-        self._hb_reported = -1
+        self.hangs = 0  #: guarded-by self._lock
+        self._hb_seq = 0  #: guarded-by self._lock
+        self._hb_begin: Optional[float] = None  #: guarded-by self._lock
+        self._hb_batch: Optional[BatchId] = None  #: guarded-by self._lock
+        self._hb_reported = -1  #: guarded-by self._lock
 
     # ------------------------------------------------------ lifecycle
 
@@ -211,7 +212,10 @@ class TrainingGuard:
                 if self._hb_reported == self._hb_seq:
                     continue
                 self._hb_reported = seq
-            self.hangs += 1
+                # under the lock: the training thread reads this counter
+                # (hang_count/report) concurrently with the watchdog, and
+                # += on an attribute is not atomic
+                self.hangs += 1
             core_telemetry.incr("training.hang")
             with core_telemetry.log_verb(
                     self, "training.hang", batch_id=repr(batch),

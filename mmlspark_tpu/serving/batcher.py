@@ -47,7 +47,9 @@ class TokenStream:
     consumers must never block forever on a request that cannot run."""
 
     def __init__(self):
-        self._q: "Queue[Optional[int]]" = Queue()
+        # one request's tokens, capped by its max_new_tokens; bounding it
+        # would let one slow client stall the batch loop for every slot
+        self._q: "Queue[Optional[int]]" = Queue()  # graftlint: disable=G403
         self.error: Optional[Exception] = None
 
     def __iter__(self) -> Iterator[int]:
@@ -198,10 +200,13 @@ class ContinuousBatcher:
         self._pos = np.zeros(s, np.int32)
         self._tok = np.zeros(s, np.int32)
         self._live: List[Optional[_Request]] = [None] * s
-        self._pending: "Queue[_Request]" = Queue()
+        # intake is bounded at submit(): past max_pending it sheds with
+        # Overloaded/503 instead of blocking the HTTP thread on a full put
+        self._pending: "Queue[_Request]" = Queue()  # graftlint: disable=G403
         # control ops (prefix register/release) serviced by the loop
-        # thread, which owns the pool/free-list/device cache
-        self._ctl: Queue = Queue()
+        # thread, which owns the pool/free-list/device cache; low-rate
+        # and must never drop or block the caller
+        self._ctl: Queue = Queue()  # graftlint: disable=G403
         # loop-thread-only FIFO between intake and admission: paged mode
         # may defer the queue head until enough pages free up
         self._buffer: "deque[_Request]" = deque()

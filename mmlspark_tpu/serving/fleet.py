@@ -93,6 +93,12 @@ class Replica:
         self.inflight = 0
         self.healthy = True
         self.draining = False
+        # sticky local drain intent (set via FleetGateway.begin_drain,
+        # under the gateway lock): a remote /health probe answered
+        # before the replica processed /admin/drain reports
+        # draining=false, and must not flip this replica back to
+        # routable mid-drain
+        self.drain_requested = False
         self.forwarded = 0
         self.errors = 0
 
@@ -300,6 +306,17 @@ class FleetGateway:
         info = server.service_info
         info.version, info.weight = version, float(weight)
         return self.add_replica(info, server=server)
+
+    def begin_drain(self, key: str) -> Optional[Replica]:
+        """Mark a replica draining, stickily: the flag is set under the
+        gateway lock and survives health probes until the replica is
+        removed (see Replica.drain_requested)."""
+        with self._lock:
+            rep = self._replicas.get(key)
+            if rep is not None:
+                rep.drain_requested = True
+                rep.draining = True
+        return rep
 
     def remove_replica(self, key: str) -> Optional[Replica]:
         with self._lock:
@@ -695,7 +712,7 @@ class FleetGateway:
     def _mark_probe(self, rep: Replica, ok: bool, draining: bool):
         with self._lock:
             was_routable = rep.routable()
-            rep.draining = draining
+            rep.draining = draining or rep.drain_requested
             if ok:
                 rep.healthy = True
                 if rep.breaker.state != "closed":

@@ -44,7 +44,10 @@ class EpochJournal:
         self._dead_records = 0
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._recovered = self._load()
-        self._f = open(path, "a", encoding="utf-8")
+        # append-only log: records are flushed per write and replay
+        # tolerates a torn tail; atomicity (tmp+fsync+replace) lives in
+        # _compact_locked, which rewrites the whole file
+        self._f = open(path, "a", encoding="utf-8")  # graftlint: disable=G404
         if self._recovered:
             # drop answered records from the recovered file ATOMICALLY
             # (tmp + rename) — the unanswered requests are never off disk,

@@ -234,8 +234,11 @@ class RolloutController:
         """begin_drain -> wait drained (bounded) -> stop, in-process via
         the ServingServer handle or remotely via /admin/drain + /health
         polling (a remote replica's process is stopped by its owner; the
-        gateway just stops routing to it)."""
-        rep.draining = True
+        gateway just stops routing to it).  The drain mark goes through
+        the gateway so it is sticky: a health probe racing this drain
+        (remote /health still says draining=false) must not flip the
+        replica back to routable."""
+        self.gateway.begin_drain(rep.key)
         deadline = time.monotonic() + self.drain_timeout_s
         if rep.server is not None:
             rep.server.server.begin_drain()

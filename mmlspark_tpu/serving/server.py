@@ -105,7 +105,7 @@ class WorkerServer:
         # The bound is enforced at HTTP admission (do_POST sheds with 503 +
         # Retry-After once qsize reaches max_queue) — bounded by default so
         # a stalled consumer can't grow the queue without limit.
-        self.queue: "Queue[CachedRequest]" = Queue()
+        self.queue: "Queue[CachedRequest]" = Queue()  # graftlint: disable=G403
         self.max_queue = None if max_queue is None else int(max_queue)
         # draining: admission sheds everything while held exchanges finish
         # (the graceful half of ServingServer.stop())
@@ -503,7 +503,9 @@ class WorkerServer:
             req = self.routing.pop(request_id, None)
         if req is None:
             raise KeyError(f"no held exchange for request {request_id!r}")
-        req.stream = Queue()
+        # chunks of one in-flight reply, drained by the held HTTP
+        # exchange as fast as the writer produces them
+        req.stream = Queue()  # graftlint: disable=G403
         req.stream_headers = dict(headers or {})
         req.done.set()
         return StreamWriter(self, req)

@@ -112,10 +112,10 @@ class FaultInjector:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._plan: Optional[FaultPlan] = None
-        self._rngs: Dict[str, "object"] = {}
-        self.calls: Dict[str, int] = {}
-        self.fires: Dict[str, int] = {}
+        self._plan: Optional[FaultPlan] = None  #: guarded-by self._lock
+        self._rngs: Dict[str, "object"] = {}  #: guarded-by self._lock
+        self.calls: Dict[str, int] = {}  #: guarded-by self._lock
+        self.fires: Dict[str, int] = {}  #: guarded-by self._lock
         # the fast-path flag read (unlocked) by fault_point(); plain
         # attribute reads/writes are atomic under the GIL
         self.active = False

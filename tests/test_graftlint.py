@@ -1,0 +1,552 @@
+"""graftlint: rule-family fixtures (G1–G4), suppressions, the baseline
+ratchet, repo cleanliness, and regression tests for the hazards the
+first full run surfaced (see docs/static_analysis.md)."""
+import json
+import os
+import sys
+import threading
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools import graftlint  # noqa: E402
+from tools.graftlint import core as gl_core  # noqa: E402
+from tools.graftlint.g1_trace import check_trace_purity  # noqa: E402
+from tools.graftlint.g2_locks import check_lock_discipline  # noqa: E402
+from tools.graftlint import g3_registry as g3  # noqa: E402
+from tools.graftlint import g4_hygiene as g4  # noqa: E402
+
+
+def _sf(src: str, rel: str = "mmlspark_tpu/fake/mod.py") -> gl_core.SourceFile:
+    return gl_core.SourceFile(os.path.join(ROOT, rel), rel, src)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ------------------------------------------------------------------ G1
+
+_G1_BAD = """\
+import jax
+import time
+import random
+from ..core import telemetry
+
+def step(x):
+    telemetry.incr("models.training.step")
+    t0 = time.perf_counter()
+    print(x)
+    random.random()
+    return x * 2
+
+fast = jax.jit(step)
+"""
+
+_G1_GOOD_HOST_LOOP = """\
+import jax
+import time
+from ..core import telemetry
+
+def step(x):
+    return x * 2
+
+fast = jax.jit(step)
+
+def fit(xs):
+    t0 = time.perf_counter()
+    for x in xs:
+        y = fast(x)
+    telemetry.incr("models.training.step")
+    print(time.perf_counter() - t0)
+    return y
+"""
+
+
+class TestG1TracePurity:
+    def test_direct_hazards_in_jitted_fn(self):
+        found = check_trace_purity([_sf(_G1_BAD)])
+        assert _rules(found) == ["G101", "G102", "G103", "G104"]
+        g101 = next(f for f in found if f.rule == "G101")
+        assert g101.symbol == "step"
+        assert g101.line == 7
+        assert "per compile" in g101.message
+
+    def test_host_loop_around_jit_is_clean(self):
+        assert check_trace_purity([_sf(_G1_GOOD_HOST_LOOP)]) == []
+
+    def test_hazard_reachable_through_helper(self):
+        src = """\
+import jax
+from ..core import telemetry
+
+def helper(x):
+    telemetry.incr("serving.request")
+    return x
+
+def step(x):
+    return helper(x)
+
+fast = jax.jit(step)
+"""
+        found = check_trace_purity([_sf(src)])
+        assert _rules(found) == ["G101"]
+        assert found[0].symbol == "helper"
+
+    def test_decorator_and_partial_roots(self):
+        src = """\
+import jax
+from functools import partial
+from ..core import telemetry
+
+@jax.jit
+def a(x):
+    print(x)
+    return x
+
+@partial(jax.jit, static_argnums=0)
+def b(x):
+    telemetry.incr("serving.request")
+    return x
+"""
+        assert _rules(check_trace_purity([_sf(src)])) == ["G101", "G104"]
+
+    def test_grad_body_and_host_sync(self):
+        src = """\
+import jax
+
+def loss(w):
+    v = (w * w).sum()
+    return v.item()
+
+g = jax.grad(loss)
+"""
+        assert _rules(check_trace_purity([_sf(src)])) == ["G106"]
+
+    def test_non_jax_jit_name_is_not_a_root(self):
+        src = """\
+from mycache import jit
+
+@jit
+def handler(x):
+    print(x)
+    return x
+"""
+        assert check_trace_purity([_sf(src)]) == []
+
+    def test_inline_suppression(self):
+        src = """\
+import jax
+
+def step(x):
+    print(x)  # graftlint: disable=G104
+    return x
+
+fast = jax.jit(step)
+"""
+        assert check_trace_purity([_sf(src)]) == []
+
+    def test_suppression_on_line_above(self):
+        src = """\
+import jax
+
+def step(x):
+    # graftlint: disable=G104 — trace-time banner, fires once
+    print(x)
+    return x
+
+fast = jax.jit(step)
+"""
+        assert check_trace_purity([_sf(src)]) == []
+
+
+# ------------------------------------------------------------------ G2
+
+_G2_BAD = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  #: guarded-by self._lock
+
+    def bump(self):
+        self.n += 1
+
+    def read(self):
+        return self.n
+
+    def locked_bump(self):
+        with self._lock:
+            self.n += 1
+"""
+
+
+class TestG2LockDiscipline:
+    def test_unlocked_write_and_read(self):
+        found = check_lock_discipline([_sf(_G2_BAD)])
+        assert _rules(found) == ["G201", "G202"]
+        by_rule = {f.rule: f for f in found}
+        assert by_rule["G201"].symbol == "Box.bump"
+        assert by_rule["G202"].symbol == "Box.read"
+
+    def test_annotation_must_name_a_real_lock(self):
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self.n = 0  #: guarded-by self._lock
+"""
+        assert _rules(check_lock_discipline([_sf(src)])) == ["G203"]
+
+    def test_lock_held_helper_propagation(self):
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  #: guarded-by self._lock
+
+    def bump(self):
+        with self._lock:
+            self._inc()
+
+    def also_bump(self):
+        with self._lock:
+            self._inc()
+
+    def _inc(self):
+        self.n += 1
+"""
+        assert check_lock_discipline([_sf(src)]) == []
+
+    def test_helper_with_one_unlocked_call_site_is_flagged(self):
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  #: guarded-by self._lock
+
+    def bump(self):
+        with self._lock:
+            self._inc()
+
+    def sneaky(self):
+        self._inc()
+
+    def _inc(self):
+        self.n += 1
+"""
+        assert _rules(check_lock_discipline([_sf(src)])) == ["G201"]
+
+    def test_annotation_on_pure_comment_line_above(self):
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: guarded-by self._lock
+        self.table = {}
+
+    def put(self, k, v):
+        self.table[k] = v
+        with self._lock:
+            pass
+"""
+        found = check_lock_discipline([_sf(src)])
+        # the READ of self.table in put() (subscript store reads the
+        # attribute) happens outside the lock
+        assert found and all(f.rule == "G202" for f in found)
+
+    def test_suppressed_lock_free_fast_path(self):
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.flag = False  #: guarded-by self._lock
+
+    def hot(self):
+        # GIL-atomic read; staleness tolerated by design
+        return self.flag  # graftlint: disable=G202
+
+    def set(self):
+        with self._lock:
+            self.flag = True
+"""
+        assert check_lock_discipline([_sf(src)]) == []
+
+
+# ------------------------------------------------------------------ G3
+
+class TestG3Registries:
+    def test_fault_point_missing_from_docs(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "robustness.md").write_text(
+            "### Registered fault points\n\n"
+            "| point | Crossed in | Exercises |\n|---|---|---|\n"
+            "| `a.b` | x | y |\n")
+        sf = _sf("from ..utils.faults import fault_point\n\n"
+                 "def go():\n"
+                 "    fault_point('a.b')\n"
+                 "    fault_point('new.point')\n")
+        found = g3._fault_registry_findings([sf], str(tmp_path))
+        assert _rules(found) == ["G301"]
+        assert "new.point" in found[0].message
+
+    def test_stale_doc_row(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "robustness.md").write_text(
+            "### Registered fault points\n\n"
+            "| `a.b` | x | y |\n| `gone.point` | x | y |\n")
+        sf = _sf("def go():\n    fault_point('a.b')\n")
+        found = g3._fault_registry_findings([sf], str(tmp_path))
+        assert _rules(found) == ["G302"]
+        assert "gone.point" in found[0].message
+
+    def test_docstring_mention_is_not_a_call_site(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "robustness.md").write_text(
+            "### Registered fault points\n")
+        sf = _sf('"""Docs mention fault_point("doc.only") here."""\n')
+        assert g3._fault_registry_findings([sf], str(tmp_path)) == []
+
+    def test_m001_and_declared_prefix(self):
+        sf = _sf('from ..core import telemetry\n'
+                 'telemetry.incr("serving.request.retry")\n'
+                 'telemetry.incr("totally.unknown")\n')
+        found = g3.metric_findings([sf], {"serving.request"})
+        assert _rules(found) == ["M001"]
+        assert "totally.unknown" in found[0].message
+
+    def test_m002_collision(self):
+        found = g3.collision_findings({"a.b", "a_b"})
+        assert _rules(found) == ["M002"]
+
+    def test_span_naming(self):
+        sf = _sf('from ..core.telemetry import span\n'
+                 'with span("oneword"):\n    pass\n'
+                 'with span("serving.request"):\n    pass\n')
+        found = g3._span_findings([sf])
+        assert _rules(found) == ["G303"]
+        assert "oneword" in found[0].message
+
+    def test_bounded_queue_without_depth_telemetry(self):
+        sf = _sf("import queue\n\n"
+                 "class Buf:\n"
+                 "    def __init__(self):\n"
+                 "        self._q = queue.Queue(maxsize=8)\n")
+        assert _rules(g3._queue_telemetry_findings([sf])) == ["G304"]
+
+    def test_bounded_queue_with_depth_gauge_is_clean(self):
+        sf = _sf("import queue\n"
+                 "from ..core.telemetry import gauge\n\n"
+                 "class Buf:\n"
+                 "    def __init__(self):\n"
+                 "        self._q = queue.Queue(maxsize=8)\n\n"
+                 "    def note(self):\n"
+                 '        gauge("io.buf.queue.depth").set(self._q.qsize())\n')
+        assert g3._queue_telemetry_findings([sf]) == []
+
+
+# ------------------------------------------------------------------ G4
+
+class TestG4Hygiene:
+    def test_unnamed_thread(self):
+        sf = _sf("import threading\n"
+                 "t = threading.Thread(target=print, daemon=True)\n")
+        assert _rules(g4.check_hygiene([sf], ROOT)) == ["G401"]
+
+    def test_nondaemon_thread_outside_leak_prefixes(self):
+        sf = _sf("import threading\n"
+                 "t = threading.Thread(target=print, name='rogue-worker')\n")
+        assert _rules(g4.check_hygiene([sf], ROOT)) == ["G402"]
+
+    def test_covered_prefix_and_daemon_are_clean(self):
+        sf = _sf("import threading\n"
+                 "a = threading.Thread(target=print, name='serve-x')\n"
+                 "b = threading.Thread(target=print, daemon=True,\n"
+                 "                     name='anything-goes')\n")
+        assert g4.check_hygiene([sf], ROOT) == []
+
+    def test_prefixes_parsed_from_conftest(self):
+        # the real conftest list, not the fallback: G402's contract is
+        # that the two can never drift
+        prefixes = g4.conftest_prefixes(ROOT)
+        assert "train-guard" in {p.rstrip("-") for p in prefixes} or \
+            any(p.startswith("train-guard") for p in prefixes)
+
+    def test_unbounded_queue_on_serving_path(self):
+        sf = _sf("import queue\nq = queue.Queue()\n",
+                 rel="mmlspark_tpu/serving/fake.py")
+        assert _rules(g4.check_hygiene([sf], ROOT)) == ["G403"]
+
+    def test_bounded_queue_and_non_serving_path_are_clean(self):
+        bounded = _sf("import queue\nq = queue.Queue(maxsize=4)\n",
+                      rel="mmlspark_tpu/serving/fake.py")
+        elsewhere = _sf("import queue\nq = queue.Queue()\n",
+                        rel="tools/fake.py")
+        assert g4.check_hygiene([bounded, elsewhere], ROOT) == []
+
+    def test_durable_write_without_fsync_rename(self):
+        sf = _sf("def save(path, blob):\n"
+                 "    with open(path, 'w') as f:\n"
+                 "        f.write(blob)\n",
+                 rel="mmlspark_tpu/models/checkpoint.py")
+        found = [f for f in g4.check_hygiene([sf], ROOT)
+                 if f.rule == "G404"]
+        assert len(found) == 1 and "os.fsync" in found[0].message
+
+    def test_tmp_fsync_rename_idiom_is_clean(self):
+        sf = _sf("import os\n\n"
+                 "def save(path, blob):\n"
+                 "    tmp = path + '.tmp'\n"
+                 "    with open(tmp, 'w') as f:\n"
+                 "        f.write(blob)\n"
+                 "        f.flush()\n"
+                 "        os.fsync(f.fileno())\n"
+                 "    os.replace(tmp, path)\n",
+                 rel="mmlspark_tpu/models/checkpoint.py")
+        assert [f for f in g4.check_hygiene([sf], ROOT)
+                if f.rule == "G404"] == []
+
+
+# ------------------------------------------------------------ baseline
+
+class TestBaselineRatchet:
+    def _finding(self, rule="G401", path="mmlspark_tpu/x.py",
+                 symbol="X.run"):
+        return gl_core.Finding(rule=rule, path=path, line=10,
+                               message="m", symbol=symbol)
+
+    def test_new_finding_fails(self):
+        res = gl_core.apply_baseline([self._finding()], {})
+        assert len(res.new) == 1 and not res.baselined and not res.stale
+
+    def test_baselined_finding_passes(self, tmp_path):
+        f = self._finding()
+        path = str(tmp_path / "baseline.json")
+        gl_core.write_baseline(path, [f])
+        res = gl_core.apply_baseline([f], gl_core.load_baseline(path))
+        assert not res.new and len(res.baselined) == 1 and not res.stale
+
+    def test_fixed_finding_flags_stale_baseline(self, tmp_path):
+        f = self._finding()
+        path = str(tmp_path / "baseline.json")
+        gl_core.write_baseline(path, [f])
+        res = gl_core.apply_baseline([], gl_core.load_baseline(path))
+        assert not res.new and not res.baselined
+        assert _rules(res.stale) == ["B001"]
+
+    def test_count_semantics(self, tmp_path):
+        # two baselined occurrences in one symbol; a third is NEW
+        f = self._finding()
+        path = str(tmp_path / "baseline.json")
+        gl_core.write_baseline(path, [f, f])
+        res = gl_core.apply_baseline(
+            [f, f, f], gl_core.load_baseline(path))
+        assert len(res.baselined) == 2 and len(res.new) == 1
+
+    def test_key_survives_line_drift(self, tmp_path):
+        f = self._finding()
+        path = str(tmp_path / "baseline.json")
+        gl_core.write_baseline(path, [f])
+        drifted = gl_core.Finding(rule=f.rule, path=f.path, line=999,
+                                  message="m", symbol=f.symbol)
+        res = gl_core.apply_baseline([drifted],
+                                     gl_core.load_baseline(path))
+        assert not res.new and len(res.baselined) == 1
+
+    def test_missing_baseline_file_is_empty(self, tmp_path):
+        assert gl_core.load_baseline(str(tmp_path / "nope.json")) == {}
+
+    def test_json_output_shape(self):
+        res = gl_core.apply_baseline([self._finding()], {})
+        doc = json.loads(gl_core.format_findings(res, json_out=True))
+        assert doc["ok"] is False
+        assert doc["findings"][0]["rule"] == "G401"
+        assert doc["baselined_count"] == 0
+
+
+# ------------------------------------------------------ repo is clean
+
+class TestRepoClean:
+    def test_zero_non_baselined_findings(self):
+        """The tier-1 gate: the tree must be graftlint-clean against the
+        checked-in baseline — a new hazard fails pytest, not just CI."""
+        res = graftlint.run_with_baseline(ROOT)
+        msgs = [f.render() for f in res.new + res.stale]
+        assert not msgs, "\n".join(msgs)
+
+    def test_rule_catalog_documents_every_reported_rule(self):
+        assert {"G101", "G201", "G301", "G401", "M001", "M002",
+                "B001"} <= set(gl_core.RULE_DOCS)
+
+
+# ------------------------------------- regressions for fixed hazards
+
+class TestFixedHazards:
+    def test_guard_hang_counter_is_lock_guarded(self):
+        """PR hazard fix 1: TrainingGuard.hangs was incremented by the
+        watchdog thread outside self._lock while the training thread
+        read it.  The attribute is now annotated and the G2 pass holds
+        the whole class to the discipline."""
+        sf = gl_core.load_source(
+            os.path.join(ROOT, "mmlspark_tpu", "models", "guard.py"),
+            ROOT)
+        assert "#: guarded-by self._lock" in sf.src
+        g2 = [f for f in check_lock_discipline([sf])
+              if f.rule in ("G201", "G202", "G203")]
+        assert g2 == [], [f.render() for f in g2]
+
+    def test_pipeline_high_water_max_merge_is_atomic(self):
+        """PR hazard fix 2: HostPipeline._high_water was a lock-free
+        read-modify-write max-merge raced by every stage worker; lost
+        updates under-reported queue depth.  _note_depth now holds
+        _hw_lock; hammer it from many threads and the max must be
+        exact."""
+        from mmlspark_tpu.io.pipeline import HostPipeline, PipelineStage
+
+        pipe = HostPipeline([PipelineStage("s", lambda x: x)])
+        depths = list(range(1, 401))
+        n_threads = 8
+
+        def hammer(offset):
+            for d in depths[offset::n_threads]:
+                pipe._note_depth("q0", d)
+
+        threads = [threading.Thread(target=hammer, args=(i,),
+                                    name=f"stream-hw-test-{i}")
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert pipe.high_water()["q0"] == max(depths)
+
+    def test_fleet_drain_mark_survives_racing_health_probe(self):
+        """PR hazard fix 3: rollout's _drain_and_stop set rep.draining
+        outside the gateway lock; a health probe answered before the
+        remote processed /admin/drain reported draining=false and
+        flipped the replica back to routable mid-drain.  begin_drain is
+        now sticky."""
+        from mmlspark_tpu.serving.fleet import FleetGateway
+        from mmlspark_tpu.serving.server import ServiceInfo
+
+        gw = FleetGateway(name="drain-race-test")
+        rep = gw.add_replica(
+            ServiceInfo("svc", "127.0.0.1", 59999, "/"))
+        assert rep.routable()
+        gw.begin_drain(rep.key)
+        assert rep.draining and not rep.routable()
+        # the racing probe: replica is alive and its /health has not
+        # flipped to draining yet — before the fix this un-drained it
+        gw._mark_probe(rep, ok=True, draining=False)
+        assert rep.draining and not rep.routable()

@@ -140,6 +140,7 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
         with FAULTS.arm(plan):
             threads = [
                 threading.Thread(target=post, daemon=True,
+                                 name=f"chaos-soak-client-{i}",
                                  args=(info.url, {"v": i}, i))
                 for i in range(n_requests)
             ]

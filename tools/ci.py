@@ -5,18 +5,21 @@ Reference: the pipeline's style gate and sharded test matrix
 20-minute budgets and flaky-retry).  One command runs the same thing
 anywhere:
 
-    python tools/ci.py lint                 # style gate + metrics lint
-    python tools/ci.py metrics-lint         # declared-metric-name check only
+    python tools/ci.py lint [--json]        # style gate + graftlint
+    python tools/ci.py metrics-lint         # M001/M002 alias (graftlint G3)
     python tools/ci.py perf-gate --fresh /tmp/bench_obs.json
                                             # bench regression gate
     python tools/ci.py fleet-smoke          # gateway kill/revive soak
     python tools/ci.py test [--shards N] [--shard K] [--retries R]
     python tools/ci.py all                  # lint + every shard
 
-Lint uses ruff when installed (configured in pyproject.toml); this image
-bakes no linter, so a built-in AST linter covers the highest-signal
-checks (syntax, unused imports, bare except, mutable default args) with
-zero dependencies.
+Lint runs two layers with zero dependencies: a built-in AST style
+linter (syntax, unused imports, bare except, mutable default args —
+ruff replaces it when installed), then **graftlint**
+(tools/graftlint/, docs/static_analysis.md): jit-purity hazards (G1),
+lock discipline (G2), registry drift incl. the old metrics-lint
+M001/M002 (G3), and resource hygiene (G4), gated by the checked-in
+baseline tools/graftlint_baseline.json.
 
 Sharding assigns test FILES round-robin over sorted order, so shard
 membership is deterministic across machines; a failed shard reruns once
@@ -34,6 +37,12 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools import graftlint as _graftlint            # noqa: E402
+from tools.graftlint import core as _gl_core         # noqa: E402
+from tools.graftlint import g3_registry as _g3       # noqa: E402
 
 LINT_TARGETS = ("mmlspark_tpu", "tests", "tools", "examples",
                 "bench.py", "__graft_entry__.py")
@@ -113,129 +122,64 @@ class _Lint(ast.NodeVisitor):
 
 
 # -------------------------------------------------------- metrics lint
+# The M001/M002 implementation moved into tools/graftlint/g3_registry.py
+# (rule ids preserved).  These shims keep the historical surface —
+# tests monkeypatch _py_files / _declared_metric_names, and
+# test_device_obs pins _sanitize_metric_name against the exposition
+# module — and `metrics_lint()` keeps its exact output contract.
 
-# where instrumented names live: incr/gauge/histogram calls on the
-# telemetry (or core_telemetry) module.  The literal (or an f-string's
-# literal prefix) must resolve against the registry's DECLARED_METRICS
-# table, so a typo'd name cannot record into a parallel series nobody
-# scrapes.
-_METRIC_CALL = re.compile(
-    r"(?:telemetry|core_telemetry)\s*\.\s*(?:incr|gauge|histogram)\s*\(\s*"
-    r"(f?)(\"|')([^\"'\n]+)\2")
-
-# bare-name calls (`from ..core.telemetry import incr` style) slip past
-# the module-prefix pattern above, so files that import the recording
-# functions directly get a second scan.  The lookbehind keeps
-# `telemetry.incr(` from double-matching.
-_METRIC_CALL_BARE = re.compile(
-    r"(?<![\w.])(?:incr|gauge|histogram)\s*\(\s*"
-    r"(f?)(\"|')([^\"'\n]+)\2")
-_TELEMETRY_IMPORT = re.compile(
-    r"from\s+[\w.]*telemetry[\w.]*\s+import\s+[^\n]*"
-    r"\b(?:incr|gauge|histogram)\b")
+_METRIC_CALL = _g3._METRIC_CALL
+_METRIC_CALL_BARE = _g3._METRIC_CALL_BARE
+_TELEMETRY_IMPORT = _g3._TELEMETRY_IMPORT
+_PROM_BAD = _g3._PROM_BAD
 
 
 def _declared_metric_names():
     """DECLARED_METRICS keys parsed out of metrics.py's dict literal via
     AST — importing mmlspark_tpu here would pull jax into every lint."""
-    path = os.path.join(ROOT, "mmlspark_tpu", "core", "telemetry",
-                        "metrics.py")
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, ast.AnnAssign):  # DECLARED_METRICS: Dict = {}
-            targets = [node.target]
-        else:
-            continue
-        if (any(isinstance(t, ast.Name) and t.id == "DECLARED_METRICS"
-                for t in targets)
-                and isinstance(node.value, ast.Dict)):
-            return {k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)}
-    raise RuntimeError(f"DECLARED_METRICS dict literal not found in {path}")
-
-
-# Prometheus-name sanitization, kept in lockstep with
-# telemetry.exposition.sanitize_name (replicated here because importing
-# mmlspark_tpu would pull jax into every lint; parity is pinned by
-# tests/test_device_obs.py)
-_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+    return _g3.declared_metric_names(ROOT)
 
 
 def _sanitize_metric_name(name: str) -> str:
-    out = _PROM_BAD.sub("_", name)
-    if out and out[0].isdigit():
-        out = "_" + out
-    return out
+    return _g3.sanitize_metric_name(name)
 
 
 def metrics_lint() -> int:
-    """Grep instrumented metric/counter names across the tree and fail
-    on any absent from DECLARED_METRICS (exact, or as a declared prefix
-    for dynamic families like `circuit.open.<host>`; an f-string's
-    dynamic tail is checked by its literal prefix).  Also fails when two
-    DECLARED names sanitize to the same Prometheus name — two dotted
-    names colliding post-sanitization would silently merge into one
-    scraped series."""
+    """Thin alias over graftlint's G3 metric checks: instrumented names
+    must resolve against DECLARED_METRICS (M001, exact or declared
+    prefix; f-strings by literal prefix) and no two declared names may
+    sanitize to the same Prometheus name (M002)."""
     declared = _declared_metric_names()
-
-    collisions = 0
-    by_prom: dict = {}
-    for name in sorted(declared):
-        pn = _sanitize_metric_name(name)
-        other = by_prom.get(pn)
-        if other is not None:
-            print(f"mmlspark_tpu/core/telemetry/metrics.py: M002 declared "
-                  f"metrics {other!r} and {name!r} both sanitize to "
-                  f"Prometheus name {pn!r}")
-            collisions += 1
-        else:
-            by_prom[pn] = name
-
-    def resolves(name: str, dynamic_tail: bool) -> bool:
-        if name in declared:
-            return True
-        if any(name.startswith(d + ".") for d in declared):
-            return True
-        # an f-string prefix like "circuit.open." must itself sit on a
-        # declared family boundary
-        return dynamic_tail and name.rstrip(".") in declared
-
-    telemetry_pkg = os.path.join("mmlspark_tpu", "core", "telemetry")
-    failures = 0
-    for path in _py_files():
-        if telemetry_pkg in path:
-            continue  # the registry's own sources/docstrings
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        matches = list(_METRIC_CALL.finditer(src))
-        if _TELEMETRY_IMPORT.search(src):
-            matches.extend(_METRIC_CALL_BARE.finditer(src))
-        for m in matches:
-            is_f, literal = m.group(1) == "f", m.group(3)
-            name = literal.split("{", 1)[0] if is_f else literal
-            if not resolves(name, dynamic_tail=is_f and "{" in literal):
-                lineno = src[:m.start()].count("\n") + 1
-                print(f"{os.path.relpath(path, ROOT)}:{lineno}: M001 "
-                      f"metric {name!r} not in DECLARED_METRICS "
-                      f"(mmlspark_tpu/core/telemetry/metrics.py)")
-                failures += 1
-    failures += collisions
+    collisions = _g3.collision_findings(declared)
+    for f in collisions:
+        print(f"{f.path}: {f.rule} {f.message}")
+    files = [_gl_core.load_source(p, ROOT) for p in _py_files()]
+    m001 = _g3.metric_findings(files, declared)
+    for f in m001:
+        print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+    failures = len(m001) + len(collisions)
     if failures:
         print(f"metrics-lint: {failures} problem(s) "
-              f"({collisions} sanitize collision(s))")
+              f"({len(collisions)} sanitize collision(s))")
     else:
         print("metrics-lint: all instrumented names declared, "
               "no sanitize collisions")
     return 1 if failures else 0
 
 
-def lint() -> int:
+def graftlint_lint(json_out: bool = False) -> int:
+    """Run the full graftlint pass set against the checked-in baseline
+    (tools/graftlint_baseline.json): any non-baselined finding — or a
+    stale baseline entry — fails."""
+    res = _graftlint.run_with_baseline(ROOT)
+    print(_gl_core.format_findings(res, json_out=json_out))
+    return 0 if not (res.new or res.stale) else 1
+
+
+def lint(json_out: bool = False) -> int:
     style_rc = _style_lint()
-    metrics_rc = metrics_lint()
-    return style_rc or metrics_rc
+    graft_rc = graftlint_lint(json_out=json_out)
+    return style_rc or graft_rc
 
 
 def _style_lint() -> int:
@@ -361,9 +305,11 @@ def main(argv=None):
                          "(default BENCH_LASTGOOD.json)")
     ap.add_argument("--scale", type=float, default=1.0,
                     help="perf-gate: widen tolerance bands")
+    ap.add_argument("--json", action="store_true",
+                    help="lint: machine-readable graftlint output")
     args = ap.parse_args(argv)
     if args.command == "lint":
-        return lint()
+        return lint(json_out=args.json)
     if args.command == "metrics-lint":
         return metrics_lint()
     if args.command == "perf-gate":

@@ -121,12 +121,14 @@ def run_soak(seed: int = 7, n_requests: int = 60, n_replicas: int = 2,
                     time.sleep(0.005)
                 action()
 
-            watcher = threading.Thread(target=watch, daemon=True)
+            watcher = threading.Thread(target=watch, daemon=True,
+                                       name="fleet-soak-watch")
             watcher.start()
         threads = []
         for i in ids:
             sem.acquire()
-            t = threading.Thread(target=run, args=(i,), daemon=True)
+            t = threading.Thread(target=run, args=(i,), daemon=True,
+                                 name=f"fleet-soak-client-{i}")
             t.start()
             threads.append(t)
         for t in threads:

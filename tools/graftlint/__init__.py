@@ -1,0 +1,70 @@
+"""graftlint — AST-based hazard analyzer for the jax_graft tree.
+
+Four pass families over ``mmlspark_tpu/``, ``tools/``, ``examples/``:
+
+* G1 (g1_trace): jit-purity / tracer hazards reachable from trace roots
+* G2 (g2_locks): ``#: guarded-by`` lock-discipline race detection
+* G3 (g3_registry): fault-point / metric / span / queue-telemetry drift
+  (absorbs the old metrics-lint M001/M002, ids preserved)
+* G4 (g4_hygiene): thread naming + leak-check coverage, bounded queues,
+  tmp+fsync+rename durable writes
+
+Run ``python -m tools.graftlint --rules`` for the catalog, or see
+docs/static_analysis.md for the full workflow (suppressions, baseline
+ratchet, CI wiring via ``tools/ci.py lint``).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from .core import (BaselineResult, Finding, RULE_DOCS, DEFAULT_TARGETS,
+                   apply_baseline, baseline_key, collect_files,
+                   format_findings, load_baseline, write_baseline)
+from .g1_trace import check_trace_purity
+from .g2_locks import check_lock_discipline
+from .g3_registry import check_registries
+from .g4_hygiene import check_hygiene
+
+__all__ = ["run", "run_with_baseline", "Finding", "BaselineResult",
+           "RULE_DOCS", "DEFAULT_TARGETS", "apply_baseline",
+           "baseline_key", "collect_files", "format_findings",
+           "load_baseline", "write_baseline", "default_baseline_path"]
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "graftlint_baseline.json")
+
+
+def run(root: str,
+        targets: Sequence[str] = DEFAULT_TARGETS,
+        rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """All findings (pre-baseline), sorted by location.  `rules`
+    filters to rule-id prefixes, e.g. ("G2", "M")."""
+    files = collect_files(root, targets)
+    findings: List[Finding] = []
+    findings += check_trace_purity(files)
+    findings += check_lock_discipline(files)
+    findings += check_registries(files, root)
+    findings += check_hygiene(files, root)
+    if rules:
+        findings = [f for f in findings
+                    if any(f.rule.startswith(r) for r in rules)]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_with_baseline(root: str,
+                      targets: Sequence[str] = DEFAULT_TARGETS,
+                      baseline_path: Optional[str] = None,
+                      rules: Optional[Sequence[str]] = None
+                      ) -> BaselineResult:
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+    findings = run(root, targets, rules=rules)
+    baseline = load_baseline(baseline_path)
+    if rules:
+        prefixes = tuple(rules)
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split("::", 1)[0].startswith(prefixes)}
+    return apply_baseline(findings, baseline)

@@ -1,0 +1,77 @@
+"""CLI: python -m tools.graftlint [paths...] [--json] [--baseline P]
+[--write-baseline] [--rules G1,G2,...] [--no-baseline]
+
+Exit status: 0 when clean (every finding baselined, no stale entries),
+1 otherwise — suitable for CI.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import (DEFAULT_TARGETS, RULE_DOCS, apply_baseline,
+               default_baseline_path, format_findings, load_baseline,
+               run, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST hazard analyzer: jit purity (G1), lock "
+                    "discipline (G2), registry drift (G3/M), resource "
+                    "hygiene (G4)")
+    ap.add_argument("paths", nargs="*",
+                    help=f"targets relative to --root "
+                         f"(default: {' '.join(DEFAULT_TARGETS)})")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: two levels above this "
+                         "package)")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON path (default: "
+                         "tools/graftlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline path "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule-id prefixes to run "
+                         "(e.g. G2,M)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULE_DOCS):
+            print(f"{rule}  {RULE_DOCS[rule]}")
+        return 0
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    targets = tuple(args.paths) or DEFAULT_TARGETS
+    rules = tuple(r.strip() for r in args.rules.split(",")) \
+        if args.rules else None
+    baseline_path = args.baseline or default_baseline_path(root)
+
+    findings = run(root, targets, rules=rules)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    if rules:
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split("::", 1)[0].startswith(tuple(rules))}
+    res = apply_baseline(findings, baseline)
+    print(format_findings(res, json_out=args.json_out))
+    return 0 if not (res.new or res.stale) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,215 @@
+"""G2: annotation-driven lock-discipline (cross-thread race) detection.
+
+The watchdog / probe / sampler / worker-pool threads all share state
+with the threads that start them; the chaos soaks exercise those paths
+but a data race only loses under the right interleaving — a soak can
+miss what an annotation check cannot.  The contract is declared where
+the state is born:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hb_seq = 0          #: guarded-by self._lock
+
+Every read or write of an annotated attribute in any method (other
+than ``__init__``, which runs before the object is shared) must then
+sit lexically inside ``with self._lock:`` — G201 for writes, G202 for
+reads.  The check is stricter than "reachable from a second thread
+entry point": annotating an attribute asserts it is shared, and a
+single-threaded access path today is one `threading.Thread(target=...)`
+away from being shared tomorrow.  Deliberate lock-free fast paths
+(GIL-atomic flag reads like ``FaultInjector.active``) carry an inline
+``# graftlint: disable=G202`` with their justification.
+
+Private helpers called *only* from inside the lock (``_Reorder._flush``
+under ``emit``/``close``) are recognized by one round of call-site
+propagation, so the guarded-helper idiom needs no annotations.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile
+
+__all__ = ["check_lock_discipline", "GUARDED_BY"]
+
+GUARDED_BY = re.compile(r"#:\s*guarded-by\s+self\.(\w+)")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.guarded: Dict[str, str] = {}      # attr -> lock attr
+        self.locks: Set[str] = set()           # lock attrs seen in __init__
+        self.methods: Dict[str, ast.AST] = {}
+        # method -> list of (caller method name, locks held at call site)
+        self.call_sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+
+
+def _collect_class(sf: SourceFile, node: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(node)
+    for child in node.body:
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[child.name] = child
+    init = info.methods.get("__init__")
+    if init is None:
+        return info
+    for stmt in ast.walk(init):
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                line = sf.lines[stmt.lineno - 1] \
+                    if stmt.lineno <= len(sf.lines) else ""
+                m = GUARDED_BY.search(line)
+                if m is None and stmt.lineno >= 2:
+                    # annotation on its own comment line directly above
+                    # (for assignments too long to annotate inline);
+                    # only a PURE comment line counts, so an inline
+                    # annotation on the previous assignment can't bleed
+                    # onto this one
+                    above = sf.lines[stmt.lineno - 2].strip()
+                    if above.startswith("#"):
+                        m = GUARDED_BY.search(above)
+                if m:
+                    info.guarded[attr] = m.group(1)
+                # any attr assigned a Lock()/RLock()/Condition() is a
+                # known lock (for G203 validation)
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call):
+                    tail = ""
+                    f = stmt.value.func
+                    if isinstance(f, ast.Attribute):
+                        tail = f.attr
+                    elif isinstance(f, ast.Name):
+                        tail = f.id
+                    if tail in ("Lock", "RLock", "Condition"):
+                        info.locks.add(attr)
+    return info
+
+
+def _walk_method(sf: SourceFile, cls: _ClassInfo, mname: str,
+                 method: ast.AST, locked_methods: Set[str],
+                 findings: List[Finding]) -> None:
+    """Flag guarded-attribute accesses outside their lock's with-block.
+
+    `locked_methods`: methods whose every intra-class call site holds
+    the relevant lock — their bodies count as lock-held."""
+    base_held: frozenset = (
+        frozenset(cls.guarded.values()) if mname in locked_methods
+        else frozenset())
+
+    def visit(node: ast.AST, held: frozenset):
+        if isinstance(node, ast.With):
+            newly = set()
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    newly.add(attr)
+            inner = held | frozenset(newly)
+            for item in node.items:
+                visit(item.context_expr, held)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr in cls.guarded and cls.guarded[attr] not in held:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                rule = "G201" if write else "G202"
+                if not sf.suppressed(rule, node.lineno):
+                    findings.append(sf.finding(
+                        rule, node.lineno,
+                        f"{'write to' if write else 'read of'} "
+                        f"self.{attr} (guarded-by self."
+                        f"{cls.guarded[attr]}) in "
+                        f"{cls.node.name}.{mname} without the lock "
+                        f"held",
+                        hint=f"wrap in 'with self."
+                             f"{cls.guarded[attr]}:' or suppress with "
+                             f"a justification"))
+        # AugAssign targets carry Store ctx on the Attribute already;
+        # nested defs (thread bodies, closures) inherit the *lexical*
+        # held set, which is correct for `with lock: def f(): ...` and
+        # conservative for closures called elsewhere
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method.body:  # type: ignore[attr-defined]
+        visit(stmt, base_held)
+
+
+def _callsite_locks(cls: _ClassInfo) -> Dict[str, List[frozenset]]:
+    """For each method name: the lock sets held at every intra-class
+    `self.m(...)` call site."""
+    out: Dict[str, List[frozenset]] = {}
+
+    for mname, method in cls.methods.items():
+        def visit(node: ast.AST, held: frozenset):
+            if isinstance(node, ast.With):
+                newly = {a for item in node.items
+                         for a in [_self_attr(item.context_expr)]
+                         if a is not None}
+                for child in node.body:
+                    visit(child, held | frozenset(newly))
+                return
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr is not None and attr in cls.methods:
+                    out.setdefault(attr, []).append(held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in method.body:  # type: ignore[attr-defined]
+            visit(stmt, frozenset())
+    return out
+
+
+def check_lock_discipline(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None or "guarded-by" not in sf.src:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _collect_class(sf, node)
+            if not cls.guarded:
+                continue
+            # G203: annotation must name a real lock from __init__
+            for attr, lock in sorted(cls.guarded.items()):
+                if lock not in cls.locks:
+                    line = node.lineno
+                    if not sf.suppressed("G203", line):
+                        findings.append(sf.finding(
+                            "G203", line,
+                            f"{node.name}.{attr} is guarded-by "
+                            f"self.{lock}, but no threading.Lock/"
+                            f"RLock/Condition named {lock!r} is "
+                            f"assigned in __init__",
+                            hint="fix the annotation or create the "
+                                 "lock"))
+            # one propagation round: private helpers whose every call
+            # site holds every declared lock count as lock-held
+            sites = _callsite_locks(cls)
+            locked_methods = {
+                m for m, helds in sites.items()
+                if m.startswith("_") and m != "__init__" and helds
+                and all(set(cls.guarded.values()) <= h for h in helds)}
+            for mname, method in sorted(cls.methods.items()):
+                if mname == "__init__":
+                    continue
+                _walk_method(sf, cls, mname, method, locked_methods,
+                             findings)
+    return findings

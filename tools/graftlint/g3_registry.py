@@ -1,0 +1,310 @@
+"""G3: registry-drift checks — the MMLSpark "reflect the surface, fail
+on drift" discipline (PAPER.md §0) applied to our runtime registries.
+
+Four invariants, each cheap to verify from source and expensive to
+violate at runtime:
+
+* **G301/G302 — fault points ↔ docs/robustness.md.**  Every
+  ``fault_point("x.y")`` call site must appear in the "Registered
+  fault points" table, and every table row must have a live call site.
+  A point missing from the table is invisible to whoever writes the
+  next chaos plan; a stale row makes a soak assert on a point that can
+  never fire.
+* **M001/M002 — metric names ↔ DECLARED_METRICS.**  Inherited verbatim
+  from the old tools/ci.py metrics-lint (ids preserved so dashboards/
+  grep habits survive): instrumented literals must resolve against the
+  declared table, and no two declared names may sanitize to the same
+  Prometheus name.
+* **G303 — span naming.**  ``span()``/``record_span()`` literals must
+  follow the ``layer.component[.detail]`` lowercase dotted convention
+  (docs/observability.md); a one-word span name is unfindable next to
+  a thousand dotted ones.
+* **G304 — bounded queues must be observable.**  A class that creates
+  a bounded ``Queue(maxsize=...)`` made a load-shedding/backpressure
+  decision; it must expose depth or shed telemetry (a metric literal
+  containing ``queue`` or ``shed``) or the first production stall is
+  invisible.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile
+
+__all__ = ["check_registries", "declared_metric_names",
+           "sanitize_metric_name", "metric_findings",
+           "collision_findings", "fault_point_sites",
+           "documented_fault_points"]
+
+# -------------------------------------------------- fault-point registry
+
+_FAULT_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|")
+_FAULT_SECTION = "### Registered fault points"
+
+
+def fault_point_sites(files: Sequence[SourceFile]
+                      ) -> Dict[str, List[Tuple[SourceFile, int]]]:
+    """Real ``fault_point("literal")`` call sites, found via AST so
+    docstring/comment mentions never count."""
+    out: Dict[str, List[Tuple[SourceFile, int]]] = {}
+    for sf in files:
+        if sf.tree is None or sf.rel.endswith("utils/faults.py"):
+            continue  # the machinery's own docstring examples
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            tail = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if tail != "fault_point" or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                out.setdefault(arg.value, []).append((sf, node.lineno))
+    return out
+
+
+def documented_fault_points(root: str) -> Tuple[Set[str], str]:
+    """Rows of the registry table in docs/robustness.md (and the doc's
+    repo-relative path for messages)."""
+    rel = "docs/robustness.md"
+    path = os.path.join(root, rel)
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except FileNotFoundError:
+        return set(), rel
+    rows: Set[str] = set()
+    in_section = False
+    for line in text.splitlines():
+        if line.strip().startswith("### "):
+            in_section = line.strip() == _FAULT_SECTION
+            continue
+        if in_section:
+            m = _FAULT_ROW.match(line.strip())
+            if m:
+                rows.add(m.group(1))
+    return rows, rel
+
+
+def _fault_registry_findings(files: Sequence[SourceFile],
+                             root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = fault_point_sites(files)
+    documented, doc_rel = documented_fault_points(root)
+    for point, where in sorted(sites.items()):
+        if point in documented:
+            continue
+        sf, line = where[0]
+        if not sf.suppressed("G301", line):
+            findings.append(sf.finding(
+                "G301", line,
+                f"fault point {point!r} is not in the registered "
+                f"fault-point table ({doc_rel})",
+                hint="add a registry row naming where it is crossed "
+                     "and what it exercises"))
+    for point in sorted(documented - set(sites)):
+        findings.append(Finding(
+            rule="G302", path=doc_rel, line=0, symbol=point,
+            message=f"registry row {point!r} has no fault_point() "
+                    f"call site in the tree",
+            hint="prune the stale row (or restore the call site)"))
+    return findings
+
+
+# ------------------------------------------------------- metric registry
+# The exact old tools/ci.py metrics-lint semantics, ids preserved.
+
+_METRIC_CALL = re.compile(
+    r"(?:telemetry|core_telemetry)\s*\.\s*(?:incr|gauge|histogram)\s*\(\s*"
+    r"(f?)(\"|')([^\"'\n]+)\2")
+_METRIC_CALL_BARE = re.compile(
+    r"(?<![\w.])(?:incr|gauge|histogram)\s*\(\s*"
+    r"(f?)(\"|')([^\"'\n]+)\2")
+_TELEMETRY_IMPORT = re.compile(
+    r"from\s+[\w.]*telemetry[\w.]*\s+import\s+[^\n]*"
+    r"\b(?:incr|gauge|histogram)\b")
+
+_TELEMETRY_PKG = "mmlspark_tpu/core/telemetry"
+
+
+def declared_metric_names(root: str) -> Set[str]:
+    """DECLARED_METRICS keys parsed out of metrics.py's dict literal via
+    AST — importing mmlspark_tpu here would pull jax into every lint."""
+    path = os.path.join(root, "mmlspark_tpu", "core", "telemetry",
+                        "metrics.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # DECLARED_METRICS: Dict = {}
+            targets = [node.target]
+        else:
+            continue
+        if (any(isinstance(t, ast.Name) and t.id == "DECLARED_METRICS"
+                for t in targets)
+                and isinstance(node.value, ast.Dict)):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)}
+    raise RuntimeError(f"DECLARED_METRICS dict literal not found in {path}")
+
+
+# Prometheus-name sanitization, kept in lockstep with
+# telemetry.exposition.sanitize_name (replicated so the lint never
+# imports jax; parity is pinned by tests/test_device_obs.py)
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    out = _PROM_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def collision_findings(declared: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    by_prom: Dict[str, str] = {}
+    for name in sorted(declared):
+        pn = sanitize_metric_name(name)
+        other = by_prom.get(pn)
+        if other is not None:
+            findings.append(Finding(
+                rule="M002", path=f"{_TELEMETRY_PKG}/metrics.py", line=0,
+                symbol="DECLARED_METRICS",
+                message=f"declared metrics {other!r} and {name!r} both "
+                        f"sanitize to Prometheus name {pn!r}",
+                hint="rename one so the scraped series stay distinct"))
+        else:
+            by_prom[pn] = name
+    return findings
+
+
+def metric_findings(files: Sequence[SourceFile],
+                    declared: Set[str]) -> List[Finding]:
+    def resolves(name: str, dynamic_tail: bool) -> bool:
+        if name in declared:
+            return True
+        if any(name.startswith(d + ".") for d in declared):
+            return True
+        # an f-string prefix like "circuit.open." must itself sit on a
+        # declared family boundary
+        return dynamic_tail and name.rstrip(".") in declared
+
+    findings: List[Finding] = []
+    for sf in files:
+        if _TELEMETRY_PKG in sf.rel:
+            continue  # the registry's own sources/docstrings
+        matches = list(_METRIC_CALL.finditer(sf.src))
+        if _TELEMETRY_IMPORT.search(sf.src):
+            matches.extend(_METRIC_CALL_BARE.finditer(sf.src))
+        for m in matches:
+            is_f, literal = m.group(1) == "f", m.group(3)
+            name = literal.split("{", 1)[0] if is_f else literal
+            if not resolves(name, dynamic_tail=is_f and "{" in literal):
+                line = sf.src[:m.start()].count("\n") + 1
+                if not sf.suppressed("M001", line):
+                    findings.append(sf.finding(
+                        "M001", line,
+                        f"metric {name!r} not in DECLARED_METRICS "
+                        f"({_TELEMETRY_PKG}/metrics.py)",
+                        hint="declare it (with its kind) or fix the "
+                             "typo"))
+    return findings
+
+
+# ---------------------------------------------------------- span naming
+
+_SPAN_CALL = re.compile(
+    r"(?<![\w.])(?:span|record_span)\s*\(\s*(f?)(\"|')([^\"'\n]+)\2")
+# layer.component[.detail...]: >= 2 lowercase dotted segments
+_SPAN_NAME = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def _span_findings(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if _TELEMETRY_PKG in sf.rel:
+            continue
+        for m in _SPAN_CALL.finditer(sf.src):
+            is_f, literal = m.group(1) == "f", m.group(3)
+            name = literal.split("{", 1)[0] if is_f else literal
+            ok = (bool(_SPAN_NAME.match(name)) if not is_f
+                  # an f-string's literal prefix must reach a dotted
+                  # boundary before the dynamic tail takes over
+                  else bool(_SPAN_NAME.match(name.rstrip(".")))
+                  and "." in name)
+            if not ok:
+                line = sf.src[:m.start()].count("\n") + 1
+                if not sf.suppressed("G303", line):
+                    findings.append(sf.finding(
+                        "G303", line,
+                        f"span name {literal!r} violates the "
+                        f"'layer.component' dotted convention",
+                        hint="use >=2 lowercase dotted segments, e.g. "
+                             "'serving.request'"))
+    return findings
+
+
+# ----------------------------------------------- bounded-queue telemetry
+
+_METRIC_LITERAL = re.compile(
+    r"(?:incr|gauge|histogram)\s*\(\s*f?(\"|')([^\"'\n]+)\1")
+
+
+def _queue_telemetry_findings(files: Sequence[SourceFile]
+                              ) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None or not sf.rel.startswith("mmlspark_tpu/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bounded_at: Optional[int] = None
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    tail = (sub.func.attr
+                            if isinstance(sub.func, ast.Attribute)
+                            else sub.func.id
+                            if isinstance(sub.func, ast.Name) else "")
+                    if tail == "Queue" and (
+                            sub.args
+                            or any(k.arg == "maxsize"
+                                   for k in sub.keywords)):
+                        bounded_at = sub.lineno
+                        break
+            if bounded_at is None:
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            body_src = "\n".join(sf.lines[node.lineno - 1:end])
+            has_depth = any(
+                ("queue" in m.group(2) and "depth" in m.group(2))
+                or "shed" in m.group(2) or "queue_depth" in m.group(2)
+                for m in _METRIC_LITERAL.finditer(body_src))
+            if not has_depth and not sf.suppressed("G304", bounded_at):
+                findings.append(sf.finding(
+                    "G304", bounded_at,
+                    f"class {node.name} bounds a Queue but declares no "
+                    f"queue-depth/shed telemetry",
+                    hint="mirror depth to a *.queue.depth gauge (and "
+                         "count sheds) so backpressure is observable"))
+    return findings
+
+
+# ----------------------------------------------------------------- entry
+
+def check_registries(files: Sequence[SourceFile], root: str
+                     ) -> List[Finding]:
+    declared = declared_metric_names(root)
+    findings = _fault_registry_findings(files, root)
+    findings += collision_findings(declared)
+    findings += metric_findings(files, declared)
+    findings += _span_findings(files)
+    findings += _queue_telemetry_findings(files)
+    return findings

@@ -1,0 +1,210 @@
+"""G4: resource hygiene — threads, queues, and durable writes.
+
+* **G401 — every thread gets a name.**  An anonymous ``Thread-7`` in a
+  py-spy dump or the conftest leak report is a dead end; every
+  ``threading.Thread(...)`` must pass ``name=``.
+* **G402 — non-daemon threads must be leak-checkable.**  The test
+  conftest fails a test only when a *non-daemon* thread whose name
+  starts with one of its infra prefixes outlives the test.  A
+  non-daemon thread named outside that list escapes the leak check
+  entirely — it can strand pytest at interpreter exit and nobody finds
+  out until CI hangs.  The prefix list is parsed from
+  ``tests/conftest.py`` so the two can never drift.
+* **G403 — no unbounded queues on serving/io paths.**  ``Queue()``
+  with no ``maxsize`` turns a slow consumer into an OOM; on the data
+  and request paths every queue is a backpressure decision and must be
+  bounded (or carry a justification on an inline disable).
+* **G404 — durable writes use tmp+fsync+rename.**  In checkpoint/
+  journal/quarantine code, ``open(path, "w")`` + ``write`` that is not
+  followed (same function) by ``os.fsync``/``flush`` and an
+  ``os.replace``/``os.rename`` can be torn by a preemption
+  mid-write — exactly the corruption the PR 10 integrity work exists
+  to catch after the fact.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from .core import Finding, SourceFile
+
+__all__ = ["check_hygiene", "conftest_prefixes"]
+
+_FALLBACK_PREFIXES: Tuple[str, ...] = (
+    "serve-", "serving-", "continuous-batcher", "stream-", "train-guard")
+
+# paths whose queues feed the serving/data planes (G403 scope)
+_QUEUE_PATHS = ("mmlspark_tpu/serving/", "mmlspark_tpu/io/",
+                "mmlspark_tpu/core/")
+# files that own durable on-disk state (G404 scope)
+_DURABLE_BASENAMES = ("checkpoint.py", "journal.py", "guard.py",
+                      "integrity.py")
+
+
+def conftest_prefixes(root: str) -> Tuple[str, ...]:
+    """_INFRA_PREFIXES parsed out of tests/conftest.py (AST, no import
+    so no pytest machinery runs); falls back to the known tuple if the
+    assignment moves."""
+    path = os.path.join(root, "tests", "conftest.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (FileNotFoundError, SyntaxError):
+        return _FALLBACK_PREFIXES
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_INFRA_PREFIXES"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = tuple(e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+                if vals:
+                    return vals
+    return _FALLBACK_PREFIXES
+
+
+def _const_kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Thread"
+    if isinstance(f, ast.Name):
+        return f.id == "Thread"
+    return False
+
+
+def _thread_findings(sf: SourceFile, prefixes: Tuple[str, ...],
+                     findings: List[Finding]) -> None:
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        name_kw = _const_kw(node, "name")
+        if name_kw is None:
+            if not sf.suppressed("G401", node.lineno):
+                findings.append(sf.finding(
+                    "G401", node.lineno,
+                    "Thread created without an explicit name=",
+                    hint="name it (infra threads: use a conftest "
+                         "leak-check prefix)"))
+            continue
+        # daemon-ness: daemon=True literal, or .daemon = True nearby is
+        # out of reach — treat only an explicit daemon=True kw as daemon
+        daemon_kw = _const_kw(node, "daemon")
+        is_daemon = (isinstance(daemon_kw, ast.Constant)
+                     and daemon_kw.value is True)
+        if is_daemon:
+            continue
+        # name may be an f-string; check its literal prefix
+        prefix_txt: Optional[str] = None
+        if isinstance(name_kw, ast.Constant) and \
+                isinstance(name_kw.value, str):
+            prefix_txt = name_kw.value
+        elif isinstance(name_kw, ast.JoinedStr) and name_kw.values and \
+                isinstance(name_kw.values[0], ast.Constant):
+            prefix_txt = str(name_kw.values[0].value)
+        if prefix_txt is None:
+            continue  # dynamic name: can't judge statically
+        if not prefix_txt.startswith(prefixes) and \
+                not sf.suppressed("G402", node.lineno):
+            findings.append(sf.finding(
+                "G402", node.lineno,
+                f"non-daemon thread name {prefix_txt!r} matches no "
+                f"conftest leak-check prefix "
+                f"({', '.join(prefixes)})",
+                hint="rename under a covered prefix, add the prefix "
+                     "to tests/conftest.py, or mark daemon=True"))
+
+
+def _queue_findings(sf: SourceFile, findings: List[Finding]) -> None:
+    if not sf.rel.startswith(_QUEUE_PATHS):
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        tail = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if tail not in ("Queue", "SimpleQueue", "LifoQueue"):
+            continue
+        bounded = bool(node.args) or any(k.arg == "maxsize"
+                                         for k in node.keywords)
+        if not bounded and not sf.suppressed("G403", node.lineno):
+            findings.append(sf.finding(
+                "G403", node.lineno,
+                f"unbounded {tail}() on a serving/io path",
+                hint="pass maxsize= (and shed on full) so a slow "
+                     "consumer backpressures instead of OOMing"))
+
+
+def _is_write_open(call: ast.Call) -> bool:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    if name != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    kw = _const_kw(call, "mode")
+    if isinstance(kw, ast.Constant):
+        mode = kw.value
+    return isinstance(mode, str) and ("w" in mode or "a" in mode)
+
+
+def _durable_findings(sf: SourceFile, findings: List[Finding]) -> None:
+    if os.path.basename(sf.rel) not in _DURABLE_BASENAMES:
+        return
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        opens: List[ast.Call] = []
+        has_fsync = has_rename = False
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if isinstance(node, ast.Call):
+                if _is_write_open(node):
+                    opens.append(node)
+                d = node.func
+                tail = d.attr if isinstance(d, ast.Attribute) else (
+                    d.id if isinstance(d, ast.Name) else "")
+                if tail == "fsync":
+                    has_fsync = True
+                if tail in ("replace", "rename"):
+                    has_rename = True
+        if opens and not (has_fsync and has_rename):
+            node = opens[0]
+            if not sf.suppressed("G404", node.lineno):
+                missing = []
+                if not has_fsync:
+                    missing.append("os.fsync")
+                if not has_rename:
+                    missing.append("os.replace")
+                findings.append(sf.finding(
+                    "G404", node.lineno,
+                    f"durable write in "
+                    f"{getattr(fn, 'name', '?')}() without "
+                    f"{' and '.join(missing)}",
+                    hint="write to a tmp path, fsync, then os.replace "
+                         "into place (atomic on POSIX)"))
+
+
+def check_hygiene(files: Sequence[SourceFile], root: str) -> List[Finding]:
+    prefixes = conftest_prefixes(root)
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        _thread_findings(sf, prefixes, findings)
+        _queue_findings(sf, findings)
+        _durable_findings(sf, findings)
+    return findings

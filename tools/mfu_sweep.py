@@ -482,7 +482,8 @@ def serving_child() -> int:
         assert wconn.getresponse().read()
         wconn.close()
         t0 = _time.perf_counter()
-        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True,
+                                    name=f"mfu-sweep-client-{ci}")
                    for ci in range(n_clients)]
         for t in threads:
             t.start()
