@@ -60,6 +60,17 @@ DECLARED_METRICS: Dict[str, str] = {
     "checkpoint.fallback": "counter",
     "checkpoint.write_failed": "counter",
     "io.pipeline.items": "counter",       # + .<stage> variants
+    # -- counters: the graftflow runtime ledger (core/flow.py, PR 12)
+    "flow.items": "counter",              # + .<stage> variants
+    "flow.shed": "counter",               # + .<stage> variants
+    "flow.expired": "counter",            # + .<stage> variants
+    # registered Stage subclasses declare their exact rows (G405)
+    "flow.shed.admission": "counter",
+    "flow.expired.admission": "counter",
+    "flow.shed.h2d": "counter",
+    "flow.expired.h2d": "counter",
+    "flow.shed.prefill": "counter",
+    "flow.expired.prefill": "counter",
     "xla.compile.count": "counter",       # every observed XLA compile
     "xla.compile.hot_path": "counter",    # + .<fn> variants: steady-state
     # -- counters: fleet gateway event ledger (serving/fleet.py, PR 9)
@@ -77,6 +88,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.feed.transfer.latency": "histogram",
     "io.feed.transfer.bytes": "histogram",
     "io.pipeline.stage.latency": "histogram",   # labeled {stage=...}
+    "flow.stage.latency": "histogram",          # labeled {stage=...}
     "io.http.request.latency": "histogram",
     "models.training.step_latency": "histogram",
     "checkpoint.verify.latency": "histogram",
@@ -91,6 +103,10 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.feed.stall_s": "gauge",
     "io.feed.queue.depth": "gauge",
     "io.pipeline.queue.depth": "gauge",   # + .<stage> variants
+    "flow.queue.depth": "gauge",          # + .<stage> variants
+    "flow.queue.depth.admission": "gauge",
+    "flow.queue.depth.h2d": "gauge",
+    "flow.queue.depth.prefill": "gauge",
     "core.batching.queue.depth": "gauge",
     "models.training.examples_per_sec": "gauge",
     "training.guard.lr_scale": "gauge",

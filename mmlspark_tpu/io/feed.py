@@ -38,13 +38,20 @@ is amortized the same way, JAX-first:
   * **Fault tolerance.**  Every `device_put` sits behind the
     `feed.device_put` fault point with a bounded retry
     (`transfer_retries`, tiny backoff — a transient link hiccup costs
-    microseconds, not a failed batch).  A PACKED transfer that fails all
-    its retries **degrades the engine**: the group falls back to plain
-    per-chunk puts and the instance stays on the safe unpipelined path
-    (no coalescing, no in-flight window) for the rest of its life —
+    microseconds, not a failed batch).  Since the graftflow unification
+    the retry ladder is a `core.flow.StagePolicy` (the same
+    retry-then-degrade shape every flow stage can wear), with backoff
+    sleeps through the injectable clock.  A PACKED transfer that fails
+    all its retries **degrades the engine**: the group falls back to
+    plain per-chunk puts and the instance stays on the safe unpipelined
+    path (no coalescing, no in-flight window) for the rest of its life —
     correctness first, the packed fast path is an optimization.  Retries
     and degradations count into `core.telemetry` ("feed.transfer_retry",
     "feed.degraded"); see docs/robustness.md (degradation ladder).
+  * **A registered flow stage.**  `DeviceFeed.stage()` exposes the h2d
+    hop as an `H2DStage` for credit-bounded FlowGraphs
+    (decode -> assemble -> h2d), with the `flow.h2d` fault point and
+    declared `flow.*.h2d` telemetry (lint rule G405).
 """
 from __future__ import annotations
 
@@ -59,10 +66,11 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 from ..core import telemetry as core_telemetry
+from ..core.flow import Stage, StagePolicy
 from ..utils.faults import fault_point
 
-__all__ = ["DeviceFeed", "FeedTelemetry", "FEED_TELEMETRY", "default_depth",
-           "FeedSource", "FEED_END"]
+__all__ = ["DeviceFeed", "H2DStage", "FeedTelemetry", "FEED_TELEMETRY",
+           "default_depth", "FeedSource", "FEED_END"]
 
 _ALIGN = 128  # byte-pack offset alignment (covers every feed dtype's itemsize)
 
@@ -261,6 +269,13 @@ class DeviceFeed:
         self.coalesce_bytes = int(coalesce_bytes)
         self.telemetry = telemetry if telemetry is not None else FEED_TELEMETRY
         self.transfer_retries = max(1, int(transfer_retries))
+        # the retry rungs of the degradation ladder, as the shared
+        # StagePolicy shape (core/flow.py); the terminal degrade rung
+        # stays at the call sites, which know whether the failed put was
+        # packed (degrade the engine) or already a singleton (raise)
+        self._put_policy = StagePolicy(retries=self.transfer_retries,
+                                       backoff_s=0.001, backoff_cap_s=0.05,
+                                       retry_counter="feed.transfer_retry")
         # a packed transfer that failed all its retries flips this: the
         # instance stays on the safe per-chunk unpipelined path for the
         # rest of its life (instances are per-transform/fit, so the blast
@@ -291,27 +306,20 @@ class DeviceFeed:
     def _device_put(self, arr, sharding=None):
         """The one raw `jax.device_put` in the engine: named fault point +
         bounded retry with a tiny backoff (a transient link error costs
-        microseconds, not the batch)."""
+        microseconds, not the batch), run as a `StagePolicy` ladder."""
         import jax
 
-        last: Optional[BaseException] = None
-        for attempt in range(self.transfer_retries):
-            try:
-                fault_point("feed.device_put")
-                # no-op unless enable_device_annotations() armed the
-                # profiler hook: the transfer span itself is recorded
-                # after the fact via record_span, which can't annotate
-                with core_telemetry.device_annotation("feed.transfer"):
-                    return (jax.device_put(arr, sharding)
-                            if sharding is not None
-                            else jax.device_put(arr))
-            except Exception as e:  # noqa: BLE001 — retried, then raised
-                last = e
-                if attempt == self.transfer_retries - 1:
-                    break
-                core_telemetry.incr("feed.transfer_retry")
-                time.sleep(min(0.001 * (2 ** attempt), 0.05))
-        raise last  # type: ignore[misc]
+        def attempt(a):
+            fault_point("feed.device_put")
+            # no-op unless enable_device_annotations() armed the
+            # profiler hook: the transfer span itself is recorded
+            # after the fact via record_span, which can't annotate
+            with core_telemetry.device_annotation("feed.transfer"):
+                return (jax.device_put(a, sharding)
+                        if sharding is not None
+                        else jax.device_put(a))
+
+        return self._put_policy.run(attempt, arr)
 
     def _degrade(self, why: str):
         if not self.degraded:
@@ -701,3 +709,35 @@ class DeviceFeed:
             self._unpackers[key] = fn
             return _first_call(fn, packed)
         return fn(packed)
+
+    # ---- the flow adapter ----------------------------------------------
+    def stage(self, workers: int = 1,
+              credits: Optional[int] = None) -> "H2DStage":
+        """This feed's h2d hop as a graftflow `Stage`, for credit-bounded
+        decode -> assemble -> h2d graphs (core/flow.py)."""
+        return H2DStage(self, workers=workers, credits=credits)
+
+
+class H2DStage(Stage):
+    """DeviceFeed's h2d hop as a registered flow stage: each item is one
+    host array (or a tuple of arrays packed into one transfer) moved
+    through the feed's guarded put path — the `feed.device_put`
+    StagePolicy retry ladder and the degrade-to-singletons terminal rung
+    ride underneath unchanged.  The bounded credit budget is the staging
+    discipline as a declared number: at most `credits` chunks staged
+    host-side per graph (lint rule G405 holds every registered Stage
+    subclass to one)."""
+
+    name = "h2d"
+    credits = 4
+
+    def __init__(self, feed: Optional[DeviceFeed] = None,
+                 workers: int = 1, credits: Optional[int] = None):
+        super().__init__(workers=workers, credits=credits)
+        self.feed = feed if feed is not None else DeviceFeed()
+
+    def process(self, value):
+        if isinstance(value, (tuple, list)):
+            return self.feed.put_group(
+                tuple(np.asarray(a) for a in value))
+        return self.feed.put(np.asarray(value))

@@ -6,21 +6,27 @@ sustains 11,167 images/sec while end-to-end ImageFeaturizer delivers
 largely serially per batch, so e2e throughput was the SUM of stage
 times instead of the MAX.  This is the pipelined-prefetch argument of
 tf.data (Murray et al., VLDB 2021) and DALI's move-preprocessing-to-
-accelerator design, applied to this stack:
+accelerator design, applied to this stack.
+
+Since the graftflow unification (core/flow.py) HostPipeline is a thin
+adapter over the credit-based `FlowGraph` runtime — the same scheduler
+that runs DeviceFeed's h2d hop and the ContinuousBatcher's admission
+and prefill stages — keeping its historical surface:
 
   * **Stages with worker pools.**  A `HostPipeline` is an ordered list
     of `PipelineStage(name, fn, workers)` map stages.  Each stage owns
-    `workers` threads pulling from a bounded input queue; the decode
-    codecs (libjpeg via `native`, PIL) release the GIL, so N decode
-    workers decode N chunks concurrently while later stages and the
-    device run ahead on earlier ones.
-  * **Bounded hand-off queues = backpressure.**  Every stage boundary
-    is a bounded queue; a slow device stalls assembly, which stalls
-    decode — memory stays O(queue_size x chunk), never O(dataset).
-  * **Order-preserving emission.**  Workers finish out of order; a
-    per-stage reorder buffer re-emits results in sequence so chunk
-    results land in feed order and the DeviceFeed's coalescer still
-    sees same-shape runs back to back.
+    `workers` threads pulling from a credit-bounded input queue; the
+    decode codecs (libjpeg via `native`, PIL) release the GIL, so N
+    decode workers decode N chunks concurrently while later stages and
+    the device run ahead on earlier ones.
+  * **Credit budgets = backpressure.**  Every stage boundary is bounded
+    by the stage's credit budget; a slow device stalls assembly, which
+    stalls decode — memory stays O(queue_size x chunk), never
+    O(dataset).
+  * **Order-preserving emission.**  Workers finish out of order; the
+    runtime's per-stage reorder buffer re-emits results in sequence so
+    chunk results land in feed order and the DeviceFeed's coalescer
+    still sees same-shape runs back to back.
   * **Feeds DeviceFeed directly.**  `feed_source(items)` adapts the
     pipeline's ordered output to the feed engine's `FeedSource`
     protocol (io/feed.py), so decode of chunk N+2, h2d of N+1, and the
@@ -30,33 +36,34 @@ accelerator design, applied to this stack:
     in `PIPELINE_TELEMETRY` (bench.py derives `decode_ms` /
     `host_assemble_ms` and the `e2e_bound` attribution from deltas);
     each item observes `io.pipeline.stage.latency{stage=...}`, queue
-    depths mirror to `io.pipeline.queue.depth.<stage>` gauges, and when
+    depths mirror to `io.pipeline.queue.depth.<stage>` gauges (the
+    legacy names, kept alongside the runtime's unified
+    `flow.queue.depth.<stage>` / `flow.items.<stage>` series), and when
     the submitting thread is inside a trace every stage item records a
     `pipeline.<stage>` child span — `/trace/<id>` shows decode spans of
     later batches overlapping the transfer/forward of earlier ones.
 
-Failure semantics: a stage exception (or a producer exception) cancels
-the pipeline, and the consumer re-raises the ORIGINAL error — no
-deadlock, no silent truncation.  All queue waits are cancel-aware
-timeout loops, so an abandoned consumer (generator closed early) or a
-dead consumer can never strand a worker.  See docs/performance.md
-("The input pipeline").
+Failure semantics are the runtime's: a stage exception (or a producer
+exception) cancels the pipeline, and the consumer re-raises the
+ORIGINAL error — no deadlock, no silent truncation.  All queue waits
+are cancel-aware timeout loops, so an abandoned consumer (generator
+closed early) or a dead consumer can never strand a worker.  See
+docs/performance.md ("The input pipeline") and docs/robustness.md
+("The flow runtime").
 """
 from __future__ import annotations
 
 import os
 import queue
 import threading
-import time
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence
 
 from ..core import telemetry as core_telemetry
+from ..core.flow import _EOF, Expired, FlowGraph, FlowItem, Stage
 from .feed import FEED_END, FeedSource
 
 __all__ = ["PipelineStage", "HostPipeline", "PipelineTelemetry",
            "PIPELINE_TELEMETRY", "pipeline_workers"]
-
-_POLL_S = 0.05  # cancel-aware queue wait quantum
 
 
 def pipeline_workers(default: Optional[int] = None) -> int:
@@ -114,7 +121,12 @@ PIPELINE_TELEMETRY = PipelineTelemetry()
 
 
 class PipelineStage:
-    """One map stage: `fn(value) -> value`, run by `workers` threads.
+    """One map stage spec: `fn(value) -> value`, run by `workers`
+    threads.  A plain spec holder, NOT a `core.flow.Stage` subclass —
+    pipeline stage names are per-call dynamic (decode/assemble/...), so
+    HostPipeline materializes anonymous base `Stage`s from these specs
+    at construction (registered Stage subclasses must declare static
+    names and budgets; see lint rule G405).
 
     `fn` must be thread-safe for workers > 1 (the decode/assembly fns
     here close over read-only inputs and write disjoint outputs)."""
@@ -126,55 +138,10 @@ class PipelineStage:
         self.workers = max(1, int(workers))
 
 
-class _EOF:
-    """End-of-stream marker carrying the total item count; re-put by the
-    worker that pops it so every sibling sees it, forwarded downstream
-    by the reorder buffer only after all `total` items emitted."""
-
-    __slots__ = ("total",)
-
-    def __init__(self, total: int):
-        self.total = total
-
-
-class _Reorder:
-    """Order-restoring emitter between a stage's workers and the next
-    queue: out-of-order completions park in `pending` until their turn.
-    `put` may block on a full downstream queue while the lock is held —
-    that IS the backpressure (siblings stall on the lock instead of
-    racing further ahead); the consumer side never takes this lock, so
-    there is no cycle to deadlock on."""
-
-    def __init__(self, put: Callable[[Any], None]):
-        self._put = put
-        self._lock = threading.Lock()
-        self._pending: Dict[int, Any] = {}  #: guarded-by self._lock
-        self._next = 0  #: guarded-by self._lock
-        self._total: Optional[int] = None  #: guarded-by self._lock
-        self._eof_sent = False  #: guarded-by self._lock
-
-    def emit(self, seq: int, value: Any):
-        with self._lock:
-            self._pending[seq] = value
-            self._flush()
-
-    def close(self, total: int):
-        with self._lock:
-            self._total = total
-            self._flush()
-
-    def _flush(self):
-        while self._next in self._pending:
-            self._put((self._next, self._pending.pop(self._next)))
-            self._next += 1
-        if (self._total is not None and self._next >= self._total
-                and not self._eof_sent):
-            self._eof_sent = True
-            self._put(_EOF(self._total))
-
-
 class HostPipeline:
-    """Bounded multi-stage streaming pipeline over an item iterable.
+    """Bounded multi-stage streaming pipeline over an item iterable —
+    a thin wrapper binding the graftflow runtime (core/flow.py) to the
+    historical io.pipeline surface and metric names.
 
     Drive it one of three ways:
       * `run(items)` — iterate the ordered final-stage outputs;
@@ -191,160 +158,69 @@ class HostPipeline:
         if not stages:
             raise ValueError("HostPipeline needs at least one stage")
         self.stages = list(stages)
-        # deep enough that every worker of the widest stage can have one
-        # item in hand and one queued; small enough to bound host memory
-        self.queue_size = max(2, int(
-            queue_size if queue_size is not None
-            else 2 * max(s.workers for s in self.stages)))
         self.telemetry = (telemetry if telemetry is not None
                           else PIPELINE_TELEMETRY)
-        self._queues: List["queue.Queue"] = []
-        self._qnames: List[str] = []
-        self._cancelled = threading.Event()
-        self._err_lock = threading.Lock()
-        self._error: Optional[BaseException] = None
-        # every stage worker and the producer race through _q_put; the
-        # read-modify-write max-merge below needs its own (tiny) lock
-        self._hw_lock = threading.Lock()
-        self._high_water: Dict[str, int] = {}  #: guarded-by self._hw_lock
-        self._started = False
-        self._ctx = None  # (trace_id, span_id) captured at start
+        self._graph = FlowGraph(
+            [Stage(name=s.name, fn=s.fn, workers=s.workers)
+             for s in self.stages],
+            queue_size=queue_size,
+            span_prefix="pipeline",
+            telemetry=self.telemetry,
+            on_depth=self._mirror_depth,
+            on_item=self._mirror_item,
+            label="HostPipeline")
+        self.queue_size = self._graph.queue_size
+
+    # legacy metric names, alongside the runtime's flow.* series
+    @staticmethod
+    def _mirror_depth(name: str, depth: int) -> None:
+        core_telemetry.gauge(f"io.pipeline.queue.depth.{name}").set(depth)
+
+    @staticmethod
+    def _mirror_item(name: str, seq: int, dt: float) -> None:
+        core_telemetry.histogram("io.pipeline.stage.latency",
+                                 stage=name).observe(dt)
+        core_telemetry.incr(f"io.pipeline.items.{name}")
 
     # ---- lifecycle -----------------------------------------------------
     def start(self, items: Iterable[Any]):
         """Spawn the producer and every stage's workers (all daemon)."""
-        if self._started:
-            raise RuntimeError("HostPipeline instances are single-use")
-        self._started = True
-        # spans from worker threads attach to the trace active where the
-        # pipeline was STARTED (the transform/fit caller), the same
-        # cross-thread hop record_span exists for
-        self._ctx = core_telemetry.current_context()
-        self._queues = [queue.Queue(maxsize=self.queue_size)
-                        for _ in self.stages]
-        self._queues.append(queue.Queue(maxsize=self.queue_size))  # out
-        self._qnames = [s.name for s in self.stages] + ["out"]
-        threading.Thread(target=self._produce, args=(items,), daemon=True,
-                         name="host-pipeline-producer").start()
-        for i, stage in enumerate(self.stages):
-            reorder = _Reorder(
-                lambda item, j=i + 1: self._q_put(j, item))
-            for w in range(stage.workers):
-                threading.Thread(
-                    target=self._worker, args=(stage, i, reorder),
-                    daemon=True,
-                    name=f"host-pipeline-{stage.name}-{w}").start()
+        self._graph.start(items)
 
     def cancel(self):
         """Stop all workers promptly; safe to call repeatedly."""
-        self._cancelled.set()
+        self._graph.cancel()
 
     @property
     def error(self) -> Optional[BaseException]:
-        return self._error
+        return self._graph.error
+
+    @property
+    def _cancelled(self) -> threading.Event:
+        return self._graph._cancelled
 
     def high_water(self) -> Dict[str, int]:
         """Max observed depth per hand-off queue (keyed by the stage the
         queue feeds, plus 'out') — the structural overlap witness: a
         stage queue that reached depth >= 2 had the previous stage
         running ahead while this one was still busy."""
-        with self._hw_lock:
-            return dict(self._high_water)
+        return self._graph.high_water()
 
     def _note_depth(self, name: str, depth: int) -> None:
-        """Max-merge one depth observation; lost updates here would
-        under-report overlap and silently pass the structural check."""
-        with self._hw_lock:
-            if depth > self._high_water.get(name, 0):
-                self._high_water[name] = depth
-
-    # ---- queue plumbing ------------------------------------------------
-    def _q_put(self, idx: int, item: Any):
-        q = self._queues[idx]
-        name = self._qnames[idx]
-        while not self._cancelled.is_set():
-            try:
-                q.put(item, timeout=_POLL_S)
-                break
-            except queue.Full:
-                continue
-        depth = q.qsize()
-        self._note_depth(name, depth)
-        core_telemetry.gauge(f"io.pipeline.queue.depth.{name}").set(depth)
-
-    def _fail(self, e: BaseException):
-        with self._err_lock:
-            if self._error is None:
-                self._error = e
-        self.cancel()
-
-    def _produce(self, items: Iterable[Any]):
-        n = 0
-        try:
-            for item in items:
-                self._q_put(0, (n, item))
-                n += 1
-        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
-            self._fail(e)
-            return
-        self._q_put(0, _EOF(n))
-
-    def _worker(self, stage: PipelineStage, idx: int, reorder: _Reorder):
-        in_q = self._queues[idx]
-        while not self._cancelled.is_set():
-            try:
-                item = in_q.get(timeout=_POLL_S)
-            except queue.Empty:
-                continue
-            if isinstance(item, _EOF):
-                # sibling workers need the marker too
-                self._q_put(idx, item)
-                reorder.close(item.total)
-                return
-            seq, value = item
-            t0 = time.perf_counter()
-            try:
-                # profiler annotation only when armed via
-                # enable_device_annotations() — same name as the
-                # record_span below so timelines and traces line up
-                with core_telemetry.device_annotation(
-                        f"pipeline.{stage.name}"):
-                    out = stage.fn(value)
-            except BaseException as e:  # noqa: BLE001 — forwarded
-                self._fail(e)
-                return
-            dt = time.perf_counter() - t0
-            self.telemetry.add(stage.name, busy_s=dt, items=1)
-            core_telemetry.histogram("io.pipeline.stage.latency",
-                                     stage=stage.name).observe(dt)
-            core_telemetry.incr(f"io.pipeline.items.{stage.name}")
-            if self._ctx is not None:
-                core_telemetry.record_span(f"pipeline.{stage.name}",
-                                           self._ctx, dt, seq=seq)
-            reorder.emit(seq, out)
+        self._graph._note_depth(name, depth)
 
     # ---- consumption ---------------------------------------------------
     def _next_out(self, block: bool = True):
         """Next ordered (seq, value) from the out queue; `_EOF` at clean
         end; raises the pipeline's error, or queue.Empty when
         non-blocking and nothing is ready."""
-        q = self._queues[-1]
-        while True:
-            try:
-                item = q.get(block=block, timeout=_POLL_S if block else None)
-            except queue.Empty:
-                if self._error is not None:
-                    raise self._error
-                if self._cancelled.is_set():
-                    raise RuntimeError("HostPipeline cancelled")
-                if block:
-                    continue
-                raise
-            if isinstance(item, _EOF):
-                if self._error is not None:
-                    raise self._error
-                return item
+        item = self._graph._next_out(block=block)
+        if isinstance(item, _EOF):
             return item
+        seq, payload = item
+        if isinstance(payload, (FlowItem, Expired)):
+            payload = payload.value
+        return (seq, payload)
 
     def run(self, items: Iterable[Any]):
         """Start and iterate the ordered final-stage outputs."""

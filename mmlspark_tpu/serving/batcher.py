@@ -33,9 +33,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import telemetry
-from ..utils.fault_tolerance import Overloaded
+from ..core.flow import AdmissionStage, FlowGraph, Stage
 
-__all__ = ["ContinuousBatcher", "TokenStream"]
+__all__ = ["ContinuousBatcher", "PrefillStage", "TokenStream"]
+
+
+class PrefillStage(Stage):
+    """Host-side prompt packing for admission prefill buckets, as a
+    registered flow stage: bucket i+1 packs on a flow worker while
+    bucket i's prefill forward occupies the device.  The bounded credit
+    budget caps how many packed buckets stage ahead of the device (lint
+    rule G405 holds every registered Stage subclass to one)."""
+
+    name = "prefill"
+    credits = 4
 
 
 class TokenStream:
@@ -200,16 +211,23 @@ class ContinuousBatcher:
         self._pos = np.zeros(s, np.int32)
         self._tok = np.zeros(s, np.int32)
         self._live: List[Optional[_Request]] = [None] * s
-        # intake is bounded at submit(): past max_pending it sheds with
-        # Overloaded/503 instead of blocking the HTTP thread on a full put
-        self._pending: "Queue[_Request]" = Queue()  # graftlint: disable=G403
+        # the intake is a graftflow AdmissionStage: bounded shed at
+        # submit() (Overloaded/503 past max_pending), expired-deadline
+        # reaping, and graceful drain are the runtime's one code path —
+        # with the batcher's historical counter/gauge names mirrored
+        self._intake = AdmissionStage(
+            max_pending=self.max_pending, label="batcher",
+            shed_counter="batcher.shed",
+            expired_counter="batcher.deadline_expired",
+            depth_gauge="serving.batcher.queue_depth")
+        # loop-thread-only FIFO between intake and admission: paged mode
+        # may defer the queue head until enough pages free up (alias —
+        # reap/drain mutate the deque in place, so it stays valid)
+        self._buffer: "deque[_Request]" = self._intake.buffer
         # control ops (prefix register/release) serviced by the loop
         # thread, which owns the pool/free-list/device cache; low-rate
         # and must never drop or block the caller
         self._ctl: Queue = Queue()  # graftlint: disable=G403
-        # loop-thread-only FIFO between intake and admission: paged mode
-        # may defer the queue head until enough pages free up
-        self._buffer: "deque[_Request]" = deque()
         self._running = threading.Event()
         self._stopped = False
         # serializes the stopped-check+enqueue in submit() against stop()'s
@@ -421,12 +439,7 @@ class ContinuousBatcher:
         admitted streams run to completion).  Load shedding: when
         `max_pending` is set and that many requests already wait,
         submit raises Overloaded (serving maps it to 503 + Retry-After)."""
-        if self.max_pending is not None and (
-                self._pending.qsize() + len(self._buffer)
-                >= self.max_pending):
-            telemetry.incr("batcher.shed")
-            raise Overloaded(
-                f"batcher intake full ({self.max_pending} pending)")
+        self._intake.shed_check()
         shared_pages = 0
         if prefix is not None:
             if not self.paged:
@@ -478,9 +491,7 @@ class ContinuousBatcher:
                 if prefix not in self._prefixes:  # released since lookup
                     raise ValueError(f"prefix {prefix} was released")
                 self._prefixes[prefix]["refs"] += 1
-            self._pending.put(req)
-        telemetry.gauge("serving.batcher.queue_depth").set(
-            self._pending.qsize() + len(self._buffer))
+            self._intake.put(req)
         return req.stream
 
     def stream_text(self, tokenizer, text: str,
@@ -544,14 +555,9 @@ class ContinuousBatcher:
         for req in self._live:
             if req is not None:
                 req.stream._q.put(None)
-        for req in self._buffer:  # loop thread is dead; buffer is ours now
-            req.stream._q.put(None)
-        self._buffer.clear()
-        while True:
-            try:
-                self._pending.get_nowait().stream._q.put(None)
-            except Empty:
-                break
+        # loop thread is dead; the intake (buffer + pending) is ours now —
+        # the runtime's one graceful-drain path settles every stream
+        self._intake.drain_all(lambda req: req.stream._q.put(None))
         while True:  # unblock any caller waiting on a control op
             try:
                 rec = self._ctl.get_nowait()
@@ -623,10 +629,8 @@ class ContinuousBatcher:
 
         buckets = sorted(by_bucket.items())
         if len(buckets) > 1:
-            from ..io.pipeline import HostPipeline, PipelineStage
-
-            packed = HostPipeline(
-                [PipelineStage("assemble", pack_bucket)]).run(buckets)
+            packed = FlowGraph([PrefillStage(fn=pack_bucket)],
+                               label="prefill").run(buckets)
         else:  # one bucket: nothing to overlap, skip the worker thread
             packed = map(pack_bucket, buckets)
         for group, kp, padded, slots in packed:
@@ -796,13 +800,7 @@ class ContinuousBatcher:
             except Exception as e:  # noqa: BLE001 — surfaced to the caller
                 rec["error"] = e
             rec["event"].set()
-        while True:
-            try:
-                self._buffer.append(self._pending.get_nowait())
-            except Empty:
-                telemetry.gauge("serving.batcher.queue_depth").set(
-                    self._pending.qsize() + len(self._buffer))
-                return
+        self._intake.drain_to_buffer()
 
     def _try_admit(self):
         """Admit from the FIFO head into free slots — collected into ONE
@@ -835,21 +833,17 @@ class ContinuousBatcher:
         if any(r.deadline is not None for r in self._buffer):
             # fail-fast: an expired request must not consume a prefill —
             # its client has already given up (deadline semantics match
-            # WorkerServer._admit; docs/robustness.md)
-            now = time.monotonic()
-            kept: "deque[_Request]" = deque()
-            for req in self._buffer:
-                if req.deadline is not None and req.deadline <= now:
-                    if req.prefix is not None:
-                        with self._submit_lock:
-                            self._prefixes[req.prefix]["refs"] -= 1
-                    telemetry.incr("batcher.deadline_expired")
-                    req.stream.error = TimeoutError(
-                        "request deadline expired before batch admission")
-                    req.stream._q.put(None)
-                else:
-                    kept.append(req)
-            self._buffer = kept
+            # WorkerServer._admit; docs/robustness.md).  The reap itself
+            # is the AdmissionStage's one code path.
+            def _expire(req: _Request):
+                if req.prefix is not None:
+                    with self._submit_lock:
+                        self._prefixes[req.prefix]["refs"] -= 1
+                req.stream.error = TimeoutError(
+                    "request deadline expired before batch admission")
+                req.stream._q.put(None)
+
+            self._intake.reap_expired(lambda r: r.deadline, _expire)
         batch = []
         for slot in range(self.max_slots):
             if not self._buffer:
@@ -881,7 +875,7 @@ class ContinuousBatcher:
                 if not self._buffer:
                     try:
                         self._buffer.append(
-                            self._pending.get(timeout=self.idle_sleep_s))
+                            self._intake.get(timeout=self.idle_sleep_s))
                     except Empty:
                         continue
                 # nothing live -> every reservation is released, so the

@@ -30,6 +30,7 @@ import numpy as np
 from ..core import telemetry
 from ..core.schema import Table
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
+from ..core.flow import deadline_expired, deadline_from_ms
 from ..utils.fault_tolerance import Overloaded
 from ..utils.faults import fault_point
 from .journal import EpochJournal
@@ -200,13 +201,10 @@ class WorkerServer:
                         {"Retry-After": "1",
                          "Content-Type": "application/json"})
                     return "shed"
-                deadline = None
-                dl_ms = self.headers.get("X-Deadline-Ms")
-                if dl_ms is not None:
-                    try:
-                        deadline = time.monotonic() + float(dl_ms) / 1000.0
-                    except ValueError:
-                        pass  # malformed budget: treat as no deadline
+                # the runtime's one deadline model (core/flow.py):
+                # malformed budgets mean no deadline
+                deadline = deadline_from_ms(
+                    self.headers.get("X-Deadline-Ms"))
                 req = CachedRequest(
                     id=uuid.uuid4().hex,
                     request=HTTPRequestData(
@@ -402,7 +400,7 @@ class WorkerServer:
         fast (504, no model compute) — the client's budget is already
         blown, computing the answer would only steal capacity from
         requests that can still make theirs."""
-        if req.deadline is not None and time.monotonic() >= req.deadline:
+        if deadline_expired(req.deadline):
             telemetry.incr("serving.deadline_expired")
             self.reply_to(req.id, HTTPResponseData(
                 504, "deadline exceeded", {"Content-Type": "application/json"},

@@ -8,8 +8,9 @@ from __future__ import annotations
 
 import concurrent.futures
 import random
-import time
 from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from .faults import sleep as _clock_sleep
 
 T = TypeVar("T")
 
@@ -93,7 +94,9 @@ def retry_with_backoff(
             sleep_s = draw(0.0, delay) if jitter else delay
             if on_retry is not None:
                 on_retry(attempt, e, sleep_s)
-            time.sleep(sleep_s)
+            # through the injectable clock (utils/faults.py): chaos tests
+            # swap in a VirtualClock so backoff ladders cost no wall time
+            _clock_sleep(sleep_s)
             delay = min(delay * backoff, max_delay_sec)
     assert last is not None
     raise last

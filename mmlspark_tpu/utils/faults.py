@@ -35,6 +35,14 @@ indices (0-based) for fully scripted scenarios.
 Every fire increments `core.telemetry` counter `faults.injected` (and
 `faults.injected.<point>`), so chaos runs leave the same audit trail as
 real failures.
+
+**Injectable clock.**  Every sleep on a failure path (injected latency
+here, retry backoff in fault_tolerance.py, stage retry ladders in
+core/flow.py) goes through module-level `sleep()` / `monotonic()`,
+which delegate to a swappable clock.  Tests and `tools/chaos_soak.py
+--flow` install a `VirtualClock` via `use_clock()` so seeded latency
+faults and exponential backoff ladders resolve in microseconds of wall
+time while still *observing* the full virtual delay.
 """
 from __future__ import annotations
 
@@ -44,7 +52,68 @@ import time
 from typing import Dict, Iterable, Optional, Set
 
 __all__ = ["InjectedFault", "InjectedCrash", "FaultRule", "FaultPlan",
-           "FaultInjector", "FAULTS", "fault_point"]
+           "FaultInjector", "FAULTS", "fault_point",
+           "sleep", "monotonic", "use_clock", "VirtualClock"]
+
+
+# ---------------------------------------------------------------------------
+# Injectable clock: failure-path sleeps delegate here so chaos tests of
+# retry/backoff ladders run in milliseconds, not wall-time.
+# ---------------------------------------------------------------------------
+class _SystemClock:
+    """Default clock: real wall time."""
+
+    monotonic = staticmethod(time.monotonic)
+    sleep = staticmethod(time.sleep)
+
+
+class VirtualClock:
+    """Deterministic test clock: `sleep` advances virtual time and
+    returns immediately.  Coarse by design — concurrent sleepers each
+    advance the shared clock, which is exactly what a chaos soak wants
+    (total injected latency stays observable in `monotonic()` without
+    costing wall time)."""
+
+    def __init__(self, start: float = 0.0):
+        self._lock = threading.Lock()
+        self._now = float(start)  #: guarded-by self._lock
+
+    def monotonic(self) -> float:
+        with self._lock:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        with self._lock:
+            self._now += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        self.sleep(seconds)
+
+
+_CLOCK = _SystemClock()
+
+
+def monotonic() -> float:
+    """Monotonic time from the active (swappable) clock."""
+    return _CLOCK.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """Failure-path sleep through the active (swappable) clock."""
+    _CLOCK.sleep(seconds)
+
+
+@contextlib.contextmanager
+def use_clock(clock):
+    """Install `clock` (anything with .monotonic()/.sleep()) for the
+    duration of the block — the chaos-soak fast path."""
+    global _CLOCK
+    prev = _CLOCK
+    _CLOCK = clock
+    try:
+        yield clock
+    finally:
+        _CLOCK = prev
 
 
 class InjectedFault(Exception):
@@ -176,7 +245,7 @@ class FaultInjector:
         telemetry.incr("faults.injected")
         telemetry.incr(f"faults.injected.{point}")
         if latency > 0:
-            time.sleep(latency)
+            sleep(latency)
         if error is not None:
             raise error(f"injected fault at {point!r} (call #{idx})")
 

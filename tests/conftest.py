@@ -66,7 +66,7 @@ def pytest_configure(config):
 # threads fail the test outright: daemon pool threads
 # (ThreadPoolExecutor) park harmlessly.
 _INFRA_PREFIXES = ("serve-", "serving-", "continuous-batcher", "stream-",
-                   "train-guard")
+                   "train-guard", "flow-")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,304 @@
+"""graftflow runtime tests: credits, ordering, deadlines, policies
+(core/flow.py — the scheduler HostPipeline, DeviceFeed's h2d hop, and
+the ContinuousBatcher's admission/prefill all ride).
+
+The deadline tests pin the shed-at-NEXT-boundary contract: a budget
+that lapses mid-graph turns the item's slot into an `Expired` marker at
+the next stage pop — io paths skip it, serving yields it (504) — and
+ordering is never lost either way.  Clock-dependent tests run under
+`VirtualClock` so backoffs and lapses cost no wall time.
+"""
+import time
+
+import pytest
+
+from mmlspark_tpu.core import telemetry
+from mmlspark_tpu.core.flow import (AdmissionStage, Expired, FlowGraph,
+                                    FlowItem, Stage, StagePolicy,
+                                    deadline_expired, deadline_from_ms,
+                                    flow_fault_points)
+from mmlspark_tpu.utils.fault_tolerance import Overloaded
+from mmlspark_tpu.utils.faults import (FAULTS, FaultPlan, VirtualClock,
+                                       monotonic, use_clock)
+
+
+def _counter(name):
+    return telemetry.counters().get(name, 0)
+
+
+# ------------------------------------------------------ deadline model
+
+def test_deadline_from_ms_parses_and_tolerates_garbage():
+    assert deadline_from_ms(None) is None
+    assert deadline_from_ms("not-a-number") is None
+    dl = deadline_from_ms("250")
+    assert dl is not None and dl > monotonic()
+    assert not deadline_expired(None)
+    assert not deadline_expired(dl)
+    assert deadline_expired(monotonic() - 0.001)
+
+
+def test_deadline_expired_accepts_explicit_now():
+    assert deadline_expired(10.0, now=10.0)     # lapsed exactly at now
+    assert not deadline_expired(10.0, now=9.99)
+
+
+# -------------------------------------------------- ordering + credits
+
+def test_parallel_workers_emit_in_order():
+    def jitter(x):
+        time.sleep((x % 3) * 0.002)  # later items finish first
+        return x * x
+
+    g = FlowGraph([Stage(name="zsq", fn=jitter, workers=4)])
+    assert list(g.run(range(40))) == [i * i for i in range(40)]
+
+
+def test_credit_budget_bounds_observed_depth():
+    g = FlowGraph(
+        [Stage(name="zslow", fn=lambda x: (time.sleep(0.004), x)[1],
+               credits=2)],
+        queue_size=3)
+    assert list(g.run(range(20))) == list(range(20))
+    hw = g.high_water()
+    assert hw.get("zslow", 0) <= 2  # the stage's declared budget
+    assert hw.get("out", 0) <= 3    # the graph's out-queue budget
+
+
+def test_stage_error_propagates_original_and_cancels():
+    def boom(x):
+        if x == 3:
+            raise ValueError("stage exploded on 3")
+        return x
+
+    g = FlowGraph([Stage(name="zerr", fn=boom)])
+    with pytest.raises(ValueError, match="stage exploded on 3"):
+        list(g.run(range(10)))
+    assert g._cancelled.is_set()
+
+
+def test_graph_is_single_use():
+    g = FlowGraph([Stage(name="zonce", fn=lambda x: x)])
+    assert list(g.run(range(3))) == [0, 1, 2]
+    with pytest.raises(RuntimeError, match="single-use"):
+        g.start(range(3))
+
+
+def test_abandoned_consumer_cancels_workers():
+    g = FlowGraph([Stage(name="zaband", fn=lambda x: x)])
+    it = g.run(range(100))
+    assert next(it) == 0
+    it.close()  # generator finally: cancel()
+    assert g._cancelled.is_set()
+
+
+# --------------------------------------- deadline lapses mid-graph
+
+def test_deadline_lapse_sheds_at_next_boundary_io_skips():
+    clock = VirtualClock()
+    with use_clock(clock):
+        generous = monotonic() + 100.0
+        tight = monotonic() + 0.05
+
+        def work(x):
+            if x == 2:
+                clock.advance(1.0)  # item 2's budget lapses inside "a"
+            return x * 10
+
+        g = FlowGraph([Stage(name="za", fn=work),
+                       Stage(name="zb", fn=lambda x: x + 1)])
+        items = [FlowItem(i, tight if i == 2 else generous)
+                 for i in range(5)]
+        before_b = _counter("flow.expired.zb")
+        before_a = _counter("flow.expired.za")
+        out = list(g.run(items))
+    # item 2 is shed (io semantics: skipped) without disturbing order
+    assert out == [1, 11, 31, 41]
+    # ...and it was shed at the NEXT boundary ("zb" pop), not at "za"
+    assert _counter("flow.expired.zb") == before_b + 1
+    assert _counter("flow.expired.za") == before_a
+
+
+def test_deadline_lapse_yields_expired_marker_in_slot_for_serving():
+    clock = VirtualClock()
+    with use_clock(clock):
+        generous = monotonic() + 100.0
+        tight = monotonic() + 0.05
+
+        def work(x):
+            if x == 2:
+                clock.advance(1.0)
+            return x * 10
+
+        g = FlowGraph([Stage(name="zc", fn=work),
+                       Stage(name="zd", fn=lambda x: x + 1)])
+        items = [FlowItem(i, tight if i == 2 else generous)
+                 for i in range(5)]
+        out = list(g.run(items, yield_expired=True))
+    # serving semantics: the marker holds its slot (maps to 504 there)
+    assert [type(v) for v in out] == [int, int, Expired, int, int]
+    assert [v for v in out if isinstance(v, int)] == [1, 11, 31, 41]
+    marker = out[2]
+    assert marker.stage == "zd"       # the boundary that shed it
+    assert marker.value == 20         # za's output still attached
+
+
+def test_graph_default_deadline_wraps_plain_items():
+    clock = VirtualClock()
+    with use_clock(clock):
+        g = FlowGraph([Stage(name="zdead", fn=lambda x: x)],
+                      deadline=monotonic() - 1.0)  # already lapsed
+        before = _counter("flow.expired.zdead")
+        out = list(g.run(range(4)))
+    assert out == []
+    assert _counter("flow.expired.zdead") == before + 4
+
+
+@pytest.mark.chaos
+def test_chaos_latency_fault_lapses_deadline_sheds_downstream():
+    """A latency fault armed at a flow.* point consumes an item's budget
+    in virtual time; the item is shed at the NEXT stage boundary while
+    generously-budgeted neighbours pass untouched."""
+    clock = VirtualClock()
+    with use_clock(clock):
+        generous = monotonic() + 100.0
+        tight = monotonic() + 0.05
+        g = FlowGraph([Stage(name="zlat", fn=lambda x: x),
+                       Stage(name="zsink", fn=lambda x: x)])
+        # workers=1: call index at flow.zlat == item index, so nth=[2]
+        # stalls exactly item 2 (whose budget is tight)
+        plan = FaultPlan(seed=5).on("flow.zlat", nth=[2], latency_s=1.0,
+                                    error=None)
+        items = [FlowItem(i, tight if i == 2 else generous)
+                 for i in range(6)]
+        before = _counter("flow.expired.zsink")
+        with FAULTS.arm(plan):
+            out = list(g.run(items))
+    assert out == [0, 1, 3, 4, 5]
+    assert _counter("flow.expired.zsink") == before + 1
+    assert FAULTS.fires.get("flow.zlat", 0) == 1
+
+
+@pytest.mark.chaos
+def test_chaos_fault_at_flow_point_recovers_via_stage_policy():
+    clock = VirtualClock()
+    pol = StagePolicy(retries=2, backoff_s=0.001)
+    g = FlowGraph([Stage(name="zchaos", fn=lambda x: x + 1, workers=2,
+                         policy=pol)])
+    plan = FaultPlan(seed=3).on("flow.zchaos", nth=[0])
+    with use_clock(clock), FAULTS.arm(plan):
+        out = list(g.run(range(10)))
+    assert out == list(range(1, 11))  # retried, nothing lost, in order
+    assert FAULTS.fires.get("flow.zchaos", 0) == 1
+
+
+# -------------------------------------------------------- StagePolicy
+
+def test_stage_policy_retries_through_virtual_clock():
+    clock = VirtualClock()
+    calls = []
+
+    def flaky(x):
+        calls.append(x)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return x
+
+    pol = StagePolicy(retries=3, backoff_s=10.0, backoff_cap_s=100.0,
+                      retry_counter="feed.transfer_retry")
+    before = _counter("feed.transfer_retry")
+    with use_clock(clock):
+        t0 = time.monotonic()
+        assert pol.run(flaky, 7) == 7
+        wall = time.monotonic() - t0
+    assert len(calls) == 3
+    assert _counter("feed.transfer_retry") == before + 2
+    assert wall < 1.0  # 10s + 20s of backoff cost no wall time
+
+
+def test_stage_policy_degrade_is_the_terminal_rung():
+    def always(x):
+        raise RuntimeError("permanent")
+
+    pol = StagePolicy(retries=2, backoff_s=0.0,
+                      degrade=lambda value, err: ("fallback", value,
+                                                  str(err)))
+    assert pol.run(always, 9) == ("fallback", 9, "permanent")
+
+
+def test_stage_policy_exhaustion_raises_last_error():
+    err = RuntimeError("the original")
+
+    def always(x):
+        raise err
+
+    pol = StagePolicy(retries=2, backoff_s=0.0)
+    with pytest.raises(RuntimeError) as ei:
+        pol.run(always, 1)
+    assert ei.value is err
+
+
+# ------------------------------------------- fault-point registration
+
+def test_flow_fault_points_auto_register_at_construction():
+    FlowGraph([Stage(name="zregprobe", fn=lambda x: x)])  # not started
+    AdmissionStage()  # registers its point at construction too
+    points = flow_fault_points()
+    assert "flow.zregprobe" in points
+    assert "flow.admission" in points
+
+
+# ------------------------------------------------------ AdmissionStage
+
+def test_admission_sheds_overloaded_past_max_pending():
+    st = AdmissionStage(max_pending=2, label="testintake",
+                        shed_counter="batcher.shed")
+    before = _counter("flow.shed.admission")
+    before_custom = _counter("batcher.shed")
+    st.offer("a")
+    st.offer("b")
+    with pytest.raises(Overloaded, match="testintake intake full"):
+        st.offer("c")
+    assert st.depth() == 2
+    assert _counter("flow.shed.admission") == before + 1
+    assert _counter("batcher.shed") == before_custom + 1
+
+
+def test_admission_unbounded_default_never_sheds():
+    st = AdmissionStage()  # max_pending=None: the seed batcher default
+    for i in range(100):
+        st.offer(i)
+    assert st.depth() == 100
+
+
+def test_admission_reap_expired_mutates_buffer_in_place():
+    clock = VirtualClock()
+    with use_clock(clock):
+        st = AdmissionStage(expired_counter="batcher.deadline_expired")
+        buf = st.buffer  # the owner's alias (the batcher keeps one)
+        now = monotonic()
+        for item in [("keep", now + 100), ("drop", now + 0.01),
+                     ("keep2", now + 100)]:
+            st.put(item)
+        st.drain_to_buffer()
+        clock.advance(1.0)
+        before = _counter("flow.expired.admission")
+        dropped = []
+        n = st.reap_expired(lambda it: it[1], dropped.append)
+    assert n == 1
+    assert [it[0] for it in dropped] == ["drop"]
+    assert st.buffer is buf  # in place: aliases survive the reap
+    assert [it[0] for it in st.buffer] == ["keep", "keep2"]
+    assert _counter("flow.expired.admission") == before + 1
+
+
+def test_admission_drain_all_settles_buffer_then_pending():
+    st = AdmissionStage()
+    st.put(1)
+    st.drain_to_buffer()
+    st.put(2)
+    st.put(3)
+    got = []
+    st.drain_all(got.append)
+    assert got == [1, 2, 3]
+    assert st.depth() == 0

@@ -355,6 +355,51 @@ class TestG3Registries:
                  '        gauge("io.buf.queue.depth").set(self._q.qsize())\n')
         assert g3._queue_telemetry_findings([sf]) == []
 
+    # ---- G405: registered flow stages declare budget + metrics -------
+
+    _G405_DECLARED = {"flow.queue.depth.h2d", "flow.shed.h2d",
+                      "flow.expired.h2d"}
+
+    def test_stage_missing_credits_and_metrics(self):
+        sf = _sf("from ..core.flow import Stage\n\n"
+                 "class RogueStage(Stage):\n"
+                 '    name = "rogue"\n')
+        found = g3._stage_findings([sf], self._G405_DECLARED)
+        assert _rules(found) == ["G405", "G405"]
+        assert "credit budget" in found[0].message
+        assert "flow.queue.depth.rogue" in found[1].message
+
+    def test_stage_without_static_name(self):
+        sf = _sf("from ..core.flow import Stage\n\n"
+                 "class DynStage(Stage):\n"
+                 "    credits = 8\n")
+        found = g3._stage_findings([sf], self._G405_DECLARED)
+        assert _rules(found) == ["G405"]
+        assert "static class-level name" in found[0].message
+
+    def test_stage_with_unbounded_credits(self):
+        sf = _sf("from ..core import flow\n\n"
+                 "class LooseStage(flow.Stage):\n"
+                 '    name = "h2d"\n'
+                 "    credits = None\n")
+        found = g3._stage_findings([sf], self._G405_DECLARED)
+        assert _rules(found) == ["G405"]
+        assert "credit budget" in found[0].message
+
+    def test_registered_stage_is_clean(self):
+        sf = _sf("from ..core.flow import Stage\n\n"
+                 "class GoodStage(Stage):\n"
+                 '    name = "h2d"\n'
+                 "    credits = 4\n")
+        assert g3._stage_findings([sf], self._G405_DECLARED) == []
+
+    def test_anonymous_spec_holder_is_out_of_scope(self):
+        # not a Stage subclass => not a registered hop (HostPipeline's
+        # PipelineStage pattern)
+        sf = _sf("class PipelineStage:\n"
+                 '    name = "whatever"\n')
+        assert g3._stage_findings([sf], self._G405_DECLARED) == []
+
 
 # ------------------------------------------------------------------ G4
 

@@ -426,7 +426,8 @@ def test_metrics_lint_catches_undeclared_name(tmp_path, monkeypatch,
 
     bad = tmp_path / "rogue.py"
     # built by concatenation so THIS file's source never matches the
-    # lint regex itself (tests/ is inside the scanned tree)
+    # lint regex itself (tests/ is excluded from the scan, but keep the
+    # fixture self-contained)
     bad.write_text('telemetry.' + 'incr("totally.undeclared.name")\n'
                    'telemetry.' + 'gauge("serving.queue.depth").set(1)\n')
     monkeypatch.setattr(ci, "_py_files", lambda: [str(bad)])

@@ -22,11 +22,20 @@ client gets exactly one response) and nothing is duplicated (each
 request id's reply observed once).  See docs/robustness.md.
 
 Usage: python tools/chaos_soak.py [--seed N] [--requests N] [--gateway]
-                                  [--json]
+                                  [--flow] [--json]
 `--gateway` runs the same plan with two replicas behind the fleet
 gateway (serving/fleet.py) — same exactly-once assertions, fleet-shaped
 shed/deadline accounting.
-Also importable (tests/test_chaos.py): run_soak(...) returns the summary.
+`--flow` soaks the graftflow runtime (core/flow.py) directly instead of
+the HTTP stack: a burst of concurrent clients offers into a bounded
+AdmissionStage (sheds past max_pending), accepted items run a
+multi-stage FlowGraph with seeded faults armed at EVERY registered
+`flow.*` point, tight deadlines reaped at intake and lapsed mid-graph
+by an injected latency fault — asserting 0 lost / 0 duplicated / order
+preserved, and that the shed/expired counters in the exported telemetry
+snapshot reconcile exactly with the observed ledger.
+Also importable (tests/test_chaos.py): run_soak(...) / run_flow_soak(...)
+return the summary.
 """
 from __future__ import annotations
 
@@ -259,6 +268,200 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
     }
 
 
+def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
+                  n_expired: int = 4, n_tight: int = 4) -> dict:
+    """Soak the graftflow runtime (core/flow.py) under seeded faults at
+    every registered `flow.*` point; returns a JSON-able summary dict,
+    raises AssertionError on any violated invariant.
+
+    The ledger it proves:
+
+      * every offered item lands in EXACTLY one bucket — shed at
+        admission (Overloaded), reaped at intake (expired before
+        admission), expired mid-graph (an `Expired` marker in its
+        slot), or delivered with the correct payload;
+      * delivered/expired slots come out in feed order (the reorder
+        contract survives retries, faults, and expiry);
+      * observed queue depths never exceed the declared credit budgets;
+      * `flow.shed.admission` / `flow.expired.*` / `faults.injected`
+        in the exported snapshot equal the observed ledger exactly.
+
+    Runs under a `VirtualClock`: injected latency and retry backoffs
+    advance virtual time only, so deadline lapses are scripted and the
+    soak resolves in milliseconds of wall time."""
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.core.flow import (AdmissionStage, Expired, FlowGraph,
+                                        FlowItem, Stage, StagePolicy,
+                                        flow_fault_points)
+    from mmlspark_tpu.utils.fault_tolerance import Overloaded
+    from mmlspark_tpu.utils.faults import (FAULTS, FaultPlan, InjectedFault,
+                                           VirtualClock, monotonic,
+                                           use_clock)
+
+    telemetry.reset_counters()
+    clock = VirtualClock()
+    with use_clock(clock):
+        intake = AdmissionStage(max_pending=max_pending, label="flow-soak")
+        policy = StagePolicy(retries=3, backoff_s=0.001)
+        graph = FlowGraph(
+            [Stage(name="decode", fn=lambda t: (t[0], t[1] * 2),
+                   workers=1, credits=4, policy=policy),
+             Stage(name="assemble", fn=lambda t: (t[0], t[1] + 1),
+                   workers=2, credits=4, policy=policy),
+             Stage(name="emit", fn=lambda t: t,
+                   workers=1, credits=4, policy=policy)],
+            queue_size=8, span_prefix="flow")
+        # arm EVERY registered flow.* point; each error rule fires at
+        # most retries-1 times so no single item can exhaust its
+        # StagePolicy ladder whatever the thread interleaving.  The
+        # decode rule is latency-only: one injected 1s stall (virtual)
+        # lapses the medium deadlines mid-graph — the shed must then
+        # happen at the NEXT boundary, never silently drop the slot.
+        config = {
+            "flow.admission": dict(nth=[2, 19]),
+            "flow.decode": dict(nth=[1], latency_s=1.0, error=None),
+            "flow.assemble": dict(nth=[2, 11]),
+            "flow.emit": dict(nth=[3, 12]),
+        }
+        plan = FaultPlan(seed=seed)
+        for p in flow_fault_points():
+            # points registered by other graphs in this process get a
+            # harmless latency-0 rule: armed, never consequential
+            plan.on(p, **config.get(p, dict(nth=[0], latency_s=0.0,
+                                            error=None)))
+        missing = [p for p in config if p not in flow_fault_points()]
+        assert not missing, f"expected flow points unregistered: {missing}"
+
+        outcomes: dict = {}  # item id -> "accepted" | "shed"
+
+        def offer(rec, i):
+            for _ in range(4):  # an injected admission fault is transient
+                try:
+                    intake.offer(rec)
+                    outcomes[i] = "accepted"
+                    return
+                except InjectedFault:
+                    continue
+                except Overloaded:
+                    outcomes[i] = "shed"
+                    return
+            raise AssertionError("admission fault retries exhausted")
+
+        total = n_tight + n_expired + n_items
+        with FAULTS.arm(plan):
+            # tight + medium budgets are offered first (room guaranteed):
+            # tights lapse BEFORE admission and must be reaped at intake,
+            # mediums lapse mid-graph when the latency fault fires
+            next_id = 0
+            for _ in range(n_tight):
+                offer(((next_id, next_id), monotonic() + 0.05), next_id)
+                next_id += 1
+            for _ in range(n_expired):
+                offer(((next_id, next_id), monotonic() + 0.5), next_id)
+                next_id += 1
+            # burst: concurrent unbudgeted offers with NO draining — the
+            # bounded intake must shed everything past max_pending
+            threads = [
+                threading.Thread(
+                    target=offer, daemon=True,
+                    name=f"flow-soak-client-{i}",
+                    args=(((i, i), None), i))
+                for i in range(next_id, total)
+            ]
+            for w in range(0, len(threads), 8):
+                for t in threads[w:w + 8]:
+                    t.start()
+                time.sleep(0.02)
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads), \
+                "offer thread still waiting: an admission was lost"
+            intake.drain_to_buffer()
+            clock.advance(0.1)  # tights lapse; mediums (0.5s) survive
+            reaped: list = []
+            intake.reap_expired(lambda it: it[1], reaped.append)
+            fed: list = []
+            intake.drain_all(fed.append)
+            out = list(graph.run(
+                (FlowItem(val, dl) for val, dl in fed),
+                yield_expired=True))
+        fires = dict(FAULTS.fires)
+
+    # ---- the ledger ------------------------------------------------------
+    shed = [i for i, o in outcomes.items() if o == "shed"]
+    accepted = [i for i, o in outcomes.items() if o == "accepted"]
+    assert len(outcomes) == total, \
+        f"offers lost: {total - len(outcomes)} items have no outcome"
+    assert len(shed) + len(accepted) == total
+    assert shed, "no admissions shed — the bounded intake proved nothing"
+    assert len(reaped) == n_tight, \
+        f"reaped {len(reaped)} tight deadlines at intake, want {n_tight}"
+    assert len(fed) == len(accepted) - n_tight
+    # ordered, exactly-once emission: slot i of `out` answers item i of
+    # `fed` — delivered values are the full transform, expired markers
+    # keep the item's id (shed at the next boundary, slot preserved)
+    assert len(out) == len(fed), \
+        f"graph emitted {len(out)} slots for {len(fed)} items"
+    markers = []
+    for (val, dl), got in zip(fed, out):
+        if isinstance(got, Expired):
+            assert got.value[0] == val[0], \
+                f"expired marker cross-wired: {got.value[0]} != {val[0]}"
+            assert dl is not None, "an unbudgeted item expired"
+            markers.append(got)
+        else:
+            assert got == (val[0], val[1] * 2 + 1), \
+                f"item {val[0]}: wrong payload {got}"
+    assert markers, "no mid-graph expiries — the latency fault proved " \
+                    "nothing"
+    # credit budgets held: no hand-off queue ever exceeded its budget
+    hw = graph.high_water()
+    for name in ("decode", "assemble", "emit"):
+        assert hw.get(name, 0) <= 4, f"{name} depth {hw[name]} > credits 4"
+    assert hw.get("out", 0) <= 8
+    # every consequential fault point fired its scripted schedule
+    assert fires.get("flow.admission", 0) == 2
+    assert fires.get("flow.decode", 0) == 1
+    assert fires.get("flow.assemble", 0) == 2
+    assert fires.get("flow.emit", 0) == 2
+
+    # ---- registry snapshot reconciliation --------------------------------
+    snapshot = telemetry.export_snapshot()
+    c = snapshot["counters"]
+    assert c.get("flow.shed.admission", 0) == len(shed), \
+        (f"flow.shed.admission {c.get('flow.shed.admission')} != "
+         f"observed sheds {len(shed)}")
+    assert c.get("flow.shed", 0) == len(shed)
+    assert c.get("flow.expired.admission", 0) == len(reaped)
+    assert c.get("flow.expired", 0) == len(reaped) + len(markers), \
+        (f"flow.expired {c.get('flow.expired')} != reaped {len(reaped)} "
+         f"+ mid-graph markers {len(markers)}")
+    assert c.get("faults.injected", 0) == sum(fires.values()), \
+        (f"registry faults.injected {c.get('faults.injected')} != "
+         f"fault-injector fires {sum(fires.values())}")
+    per_stage_expired = sum(v for k, v in c.items()
+                            if k.startswith("flow.expired.")
+                            and k != "flow.expired.admission")
+    assert per_stage_expired == len(markers), \
+        "per-stage flow.expired.* rows do not sum to the marker count"
+
+    return {
+        "seed": seed,
+        "mode": "flow",
+        "offered": total,
+        "accepted": len(accepted),
+        "shed": len(shed),
+        "reaped_at_intake": len(reaped),
+        "expired_mid_graph": len(markers),
+        "delivered": len(fed) - len(markers),
+        "lost": 0,
+        "duplicated": 0,
+        "faults_fired": fires,
+        "high_water": hw,
+        "counters": c,
+    }
+
+
 def write_obs_snapshot(path) -> str:
     """Dump the full observability snapshot (counters, gauges, histogram
     buckets, AND the recent-span ring) to `path` — the input format
@@ -294,18 +497,35 @@ def main(argv=None):
     ap.add_argument("--gateway", action="store_true",
                     help="drive traffic through a FleetGateway fronting "
                          "two replicas instead of a single worker")
+    ap.add_argument("--flow", action="store_true",
+                    help="soak the graftflow runtime (core/flow.py) with "
+                         "faults at every registered flow.* point instead "
+                         "of the HTTP stack")
+    ap.add_argument("--max-pending", type=int, default=24,
+                    help="--flow: AdmissionStage intake bound")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON object")
     ap.add_argument("--obs-out", metavar="PATH", default=None,
                     help="write the full observability snapshot (spans "
                          "included) to PATH for tools/obs_report.py")
     args = ap.parse_args(argv)
-    summary = run_soak(seed=args.seed, n_requests=args.requests,
-                       max_queue=args.max_queue, gateway=args.gateway)
+    if args.flow:
+        summary = run_flow_soak(seed=args.seed, n_items=args.requests,
+                                max_pending=args.max_pending)
+    else:
+        summary = run_soak(seed=args.seed, n_requests=args.requests,
+                           max_queue=args.max_queue, gateway=args.gateway)
     if args.obs_out:
         write_obs_snapshot(args.obs_out)
     if args.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
+    elif args.flow:
+        print(f"flow soak OK: {summary['delivered']} delivered, "
+              f"{summary['shed']} shed at admission, "
+              f"{summary['reaped_at_intake']} reaped at intake, "
+              f"{summary['expired_mid_graph']} expired mid-graph, "
+              f"0 lost, 0 duplicated; faults fired: "
+              f"{summary['faults_fired']}")
     else:
         print(f"chaos soak OK: {summary['answered_200']} answered, "
               f"{summary['shed_503']} shed (503), "

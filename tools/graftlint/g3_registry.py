@@ -24,6 +24,16 @@ violate at runtime:
   decision; it must expose depth or shed telemetry (a metric literal
   containing ``queue`` or ``shed``) or the first production stall is
   invisible.
+* **G405 — registered flow stages declare budget + metrics.**  Every
+  ``core.flow.Stage`` subclass is a named, registered hop in the
+  graftflow runtime; it must pin a bounded class-level credit budget
+  (``credits = <positive int>``) and a static ``name`` whose
+  ``flow.queue.depth.<name>`` / ``flow.shed.<name>`` /
+  ``flow.expired.<name>`` series all appear in DECLARED_METRICS — a
+  stage with an inherited (unbounded-by-default) budget or undeclared
+  per-stage series is a hop the dashboards and the chaos ledger cannot
+  see.  Anonymous base-``Stage`` instances (dynamic names, e.g.
+  HostPipeline's) are deliberately out of scope.
 """
 from __future__ import annotations
 
@@ -297,6 +307,77 @@ def _queue_telemetry_findings(files: Sequence[SourceFile]
     return findings
 
 
+# ------------------------------------------- flow-stage registration
+
+def _class_attr_values(node: ast.ClassDef) -> Dict[str, ast.expr]:
+    """Top-level ``name = value`` / ``name: T = value`` assignments of a
+    class body (methods and nested scopes excluded on purpose)."""
+    out: Dict[str, ast.expr] = {}
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value
+        elif (isinstance(stmt, ast.AnnAssign)
+              and isinstance(stmt.target, ast.Name)
+              and stmt.value is not None):
+            out[stmt.target.id] = stmt.value
+    return out
+
+
+def _stage_findings(files: Sequence[SourceFile],
+                    declared: Set[str]) -> List[Finding]:
+    """G405: every ``Stage`` subclass must pin a bounded credit budget
+    and have its per-stage flow.* series declared."""
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None or not sf.rel.startswith("mmlspark_tpu/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            base_tails = [b.attr if isinstance(b, ast.Attribute)
+                          else b.id if isinstance(b, ast.Name) else ""
+                          for b in node.bases]
+            if "Stage" not in base_tails:
+                continue
+            if sf.suppressed("G405", node.lineno):
+                continue
+            problems: List[str] = []
+            attrs = _class_attr_values(node)
+            credits = attrs.get("credits")
+            if not (isinstance(credits, ast.Constant)
+                    and isinstance(credits.value, int)
+                    and not isinstance(credits.value, bool)
+                    and credits.value > 0):
+                problems.append(
+                    "no bounded class-level credit budget "
+                    "(credits = <positive int>)")
+            name = attrs.get("name")
+            if not (isinstance(name, ast.Constant)
+                    and isinstance(name.value, str)):
+                problems.append(
+                    "no static class-level name (a string literal)")
+            else:
+                missing = [m for m in (f"flow.queue.depth.{name.value}",
+                                       f"flow.shed.{name.value}",
+                                       f"flow.expired.{name.value}")
+                           if m not in declared]
+                if missing:
+                    problems.append(
+                        "per-stage series missing from DECLARED_METRICS: "
+                        + ", ".join(missing))
+            for problem in problems:
+                findings.append(sf.finding(
+                    "G405", node.lineno,
+                    f"registered flow stage {node.name}: {problem}",
+                    hint="registered Stage subclasses must declare a "
+                         "bounded credits budget and their exact "
+                         "flow.queue.depth/shed/expired.<name> rows "
+                         "(see docs/static_analysis.md)"))
+    return findings
+
+
 # ----------------------------------------------------------------- entry
 
 def check_registries(files: Sequence[SourceFile], root: str
@@ -307,4 +388,5 @@ def check_registries(files: Sequence[SourceFile], root: str
     findings += metric_findings(files, declared)
     findings += _span_findings(files)
     findings += _queue_telemetry_findings(files)
+    findings += _stage_findings(files, declared)
     return findings
