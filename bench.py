@@ -274,6 +274,101 @@ def _measure_guard(steps: int = 96, batch: int = 32,
     }
 
 
+def _measure_sanitizer(n_items: int = 400, reps: int = 5) -> dict:
+    """Disabled-path cost of the runtime concurrency sanitizer hooks
+    (tools/graftsan).  The flow runtime carries `_SAN is not None`
+    branches at every credit acquire/release and EOF enqueue, plus the
+    `make_lock` factory indirection at lock construction; the contract
+    is that with graftsan NOT installed those cost <1% of the flow
+    runtime's per-item wall.  Measured as min-of-medians per-item wall
+    of a 2-stage FlowGraph against a reference run with the pre-hook
+    `_Credits.acquire/release` and `FlowGraph._enqueue` bodies swapped
+    back in verbatim; perf_gate bands `sanitizer_overhead_frac`.  The
+    sanitizer-ENABLED fraction rides along informationally (it buys the
+    lockset/credit audits; it is not gated)."""
+    import queue as queue_mod
+    import statistics
+
+    from mmlspark_tpu.core import flow as flow_mod
+    from mmlspark_tpu.core import telemetry as core_telemetry
+    from mmlspark_tpu.core.flow import _POLL_S, FlowGraph, Stage
+
+    def run_once() -> float:
+        g = FlowGraph([Stage("san_bench_a", fn=lambda x: x + 1, workers=2),
+                       Stage("san_bench_b", fn=lambda x: x * 2, workers=2)],
+                      queue_size=8, label="sanitizer-bench")
+        t0 = time.perf_counter()
+        n = sum(1 for _ in g.run(range(n_items)))
+        dt = time.perf_counter() - t0
+        assert n == n_items
+        return dt / n_items
+
+    # the pre-hook bodies, verbatim (minus the _SAN lines) — swapped in
+    # for the reference runs so both sides pay identical queue/credit/
+    # telemetry work and differ ONLY by the disabled-hook branches
+    def _ref_acquire(self, cancelled) -> bool:
+        while not cancelled.is_set():
+            if self._sem.acquire(timeout=_POLL_S):
+                return True
+        return False
+
+    def _ref_release(self) -> None:
+        self._sem.release()
+
+    def _ref_enqueue(self, idx, item):
+        q = self._queues[idx]
+        while not self._cancelled.is_set():
+            try:
+                q.put(item, timeout=_POLL_S)
+                break
+            except queue_mod.Full:
+                continue
+        name = self._qnames[idx]
+        depth = q.qsize()
+        self._note_depth(name, depth)
+        core_telemetry.gauge(f"flow.queue.depth.{name}").set(depth)
+        if self._on_depth is not None:
+            self._on_depth(name, depth)
+
+    hooked = (flow_mod._Credits.acquire, flow_mod._Credits.release,
+              flow_mod.FlowGraph._enqueue)
+
+    def run_median(patched: bool) -> float:
+        if patched:
+            flow_mod._Credits.acquire = _ref_acquire
+            flow_mod._Credits.release = _ref_release
+            flow_mod.FlowGraph._enqueue = _ref_enqueue
+        try:
+            return statistics.median(run_once() for _ in range(3))
+        finally:
+            (flow_mod._Credits.acquire, flow_mod._Credits.release,
+             flow_mod.FlowGraph._enqueue) = hooked
+
+    # interleaved best-of-N: min-of-medians cancels machine-load drift
+    # (same methodology as guard_overhead_frac — the band is absolute)
+    refs, live = [], []
+    for _ in range(reps):
+        refs.append(run_median(patched=True))
+        live.append(run_median(patched=False))
+    import tools.graftsan as graftsan
+
+    try:
+        graftsan.install()
+        enabled = run_median(patched=False)
+    finally:
+        graftsan.uninstall()
+    ref, disabled = min(refs), min(live)
+    # clamp at zero: the hooked path is a superset of the reference, so
+    # a negative fraction is noise — and a negative LASTGOOD base would
+    # tighten perf_gate's absolute band for free
+    return {
+        "sanitizer_overhead_frac": max(
+            0.0, round((disabled - ref) / ref, 4)),
+        "sanitizer_enabled_overhead_frac": round(
+            (enabled - ref) / ref, 4),
+    }
+
+
 def _measure_transformer(batch: int = 16, seq: int = 1024,
                          steps: int = 8,
                          force_xla_attn: bool = False) -> dict:
@@ -620,6 +715,10 @@ def _child_measure():
         guard = _measure_guard()
     except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
         guard = {"guard_error": str(e)[-200:]}
+    try:
+        san = _measure_sanitizer()
+    except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
+        san = {"sanitizer_error": str(e)[-200:]}
     # the registry's own view of the run rides along so --obs-out saves
     # a self-describing snapshot (meta: backend/devices/pid/timestamp)
     from mmlspark_tpu.core import telemetry as core_telemetry
@@ -628,7 +727,7 @@ def _child_measure():
         include_spans=False,
         timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm,
-                      "guard": guard, "obs": obs}))
+                      "guard": guard, "san": san, "obs": obs}))
 
 
 def _obs_out_path():
@@ -767,6 +866,8 @@ def main():
         **{k: v for k, v in child.get("vit", {}).items() if v is not None},
         **{k: v for k, v in child.get("lm", {}).items() if v is not None},
         **{k: v for k, v in child.get("guard", {}).items()
+           if v is not None},
+        **{k: v for k, v in child.get("san", {}).items()
            if v is not None},
         "device_kind": res["device_kind"],
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

@@ -62,6 +62,7 @@ from ..utils.fault_tolerance import Overloaded
 from ..utils.faults import fault_point
 from ..utils.faults import monotonic as _clock_monotonic
 from ..utils.faults import sleep as _clock_sleep
+from ..utils.sync import make_lock
 from . import telemetry as core_telemetry
 
 __all__ = ["Stage", "StagePolicy", "FlowGraph", "FlowItem", "Expired",
@@ -70,12 +71,25 @@ __all__ = ["Stage", "StagePolicy", "FlowGraph", "FlowItem", "Expired",
 
 _POLL_S = 0.05  # cancel-aware queue/credit wait quantum
 
+# The runtime sanitizer's observer (tools/graftsan), or None.  Installed
+# via set_sanitizer(); every hook site below is a plain attribute read
+# plus a None check, priced by bench.py's `sanitizer_overhead_frac`
+# contract (< 1% on the per-item flow path when disabled).
+_SAN = None
+
+
+def set_sanitizer(observer) -> None:
+    """Install (or, with None, remove) the credit/EOF conservation
+    observer.  Called by tools/graftsan install()/uninstall() only."""
+    global _SAN
+    _SAN = observer
+
 
 # ---------------------------------------------------------------------------
 # Fault-point auto-registration: every queue in the system becomes
 # chaos-injectable the moment a graph is built around it.
 # ---------------------------------------------------------------------------
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock("flow.registry")
 _FLOW_FAULT_POINTS: Dict[str, None] = {}  #: guarded-by _REG_LOCK
 
 
@@ -172,10 +186,14 @@ class _Credits:
         """Block for a credit; False when the graph cancelled first."""
         while not cancelled.is_set():
             if self._sem.acquire(timeout=_POLL_S):
+                if _SAN is not None:
+                    _SAN.on_credit_acquire(self)
                 return True
         return False
 
     def release(self) -> None:
+        if _SAN is not None:
+            _SAN.on_credit_release(self)
         self._sem.release()
 
 
@@ -189,7 +207,7 @@ class _Reorder:
 
     def __init__(self, put: Callable[[Any], None]):
         self._put = put
-        self._lock = threading.Lock()
+        self._lock = make_lock("flow.reorder")
         self._pending: Dict[int, Any] = {}  #: guarded-by self._lock
         self._next = 0  #: guarded-by self._lock
         self._total: Optional[int] = None  #: guarded-by self._lock
@@ -357,16 +375,18 @@ class FlowGraph:
         self._queues: List["queue.Queue"] = []
         self._qnames = [s.name for s in self.stages] + ["out"]
         self._cancelled = threading.Event()
-        self._err_lock = threading.Lock()
+        self._err_lock = make_lock("flow.err")
         self._error: Optional[BaseException] = None
         # every stage worker and the producer race through _enqueue; the
         # read-modify-write max-merge below needs its own (tiny) lock
-        self._hw_lock = threading.Lock()
+        self._hw_lock = make_lock("flow.high_water")
         self._high_water: Dict[str, int] = {}  #: guarded-by self._hw_lock
         self._started = False
         self._ctx = None  # (trace_id, span_id) captured at start
         for s in self.stages:
             _register_fault_point(f"flow.{s.name}")
+        if _SAN is not None:
+            _SAN.on_graph(self)
 
     # ---- lifecycle -----------------------------------------------------
     def start(self, items: Iterable[Any]):
@@ -422,6 +442,8 @@ class FlowGraph:
                 break
             except queue.Full:
                 continue
+        if _SAN is not None and isinstance(item, _EOF):
+            _SAN.on_eof(self, idx)
         name = self._qnames[idx]
         depth = q.qsize()
         self._note_depth(name, depth)
@@ -543,6 +565,10 @@ class FlowGraph:
             if isinstance(item, _EOF):
                 if self._error is not None:
                     raise self._error
+                if _SAN is not None:
+                    # clean end-of-stream: every credit must be home —
+                    # the sanitizer audits the ledger at this instant
+                    _SAN.on_graph_eof(self)
                 return item
             self._credits[-1].release()
             return item

@@ -68,6 +68,7 @@ import numpy as np
 from ..core import telemetry as core_telemetry
 from ..core.flow import Stage, StagePolicy
 from ..utils.faults import fault_point
+from ..utils.sync import make_lock
 
 __all__ = ["DeviceFeed", "H2DStage", "FeedTelemetry", "FEED_TELEMETRY",
            "default_depth", "FeedSource", "FEED_END"]
@@ -178,7 +179,7 @@ class FeedTelemetry:
                "stall_drain_s", "compute_s", "wall_s")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("io.feed.telemetry")
         self._c: Dict[str, float] = {f: 0.0 for f in self._FIELDS}
 
     def add(self, **kw: float):

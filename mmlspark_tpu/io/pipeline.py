@@ -60,6 +60,7 @@ from typing import Any, Callable, Dict, Iterable, Optional, Sequence
 
 from ..core import telemetry as core_telemetry
 from ..core.flow import _EOF, Expired, FlowGraph, FlowItem, Stage
+from ..utils.sync import make_lock
 from .feed import FEED_END, FeedSource
 
 __all__ = ["PipelineStage", "HostPipeline", "PipelineTelemetry",
@@ -92,7 +93,7 @@ class PipelineTelemetry:
     items/busy_s x workers)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("io.pipeline.telemetry")
         self._stages: Dict[str, Dict[str, float]] = {}  #: guarded-by self._lock
 
     def add(self, stage: str, busy_s: float = 0.0, items: int = 0):

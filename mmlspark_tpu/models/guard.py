@@ -53,6 +53,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from ..core import telemetry as core_telemetry
+from ..utils.sync import make_lock
 
 __all__ = ["GuardAction", "TrainingAborted", "TrainingGuard"]
 
@@ -130,7 +131,7 @@ class TrainingGuard:
         # plus a begin timestamp; the reported-latch keeps one hung step
         # from firing the alarm every poll tick.  Everything the
         # watchdog thread and the training thread both touch is guarded.
-        self._lock = threading.Lock()
+        self._lock = make_lock("models.guard.state")
         self._wd_thread: Optional[threading.Thread] = None
         self._wd_stop = threading.Event()
         self.hangs = 0  #: guarded-by self._lock

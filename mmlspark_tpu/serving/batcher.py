@@ -34,6 +34,7 @@ import numpy as np
 
 from ..core import telemetry
 from ..core.flow import AdmissionStage, FlowGraph, Stage
+from ..utils.sync import make_rlock
 
 __all__ = ["ContinuousBatcher", "PrefillStage", "TokenStream"]
 
@@ -235,7 +236,7 @@ class ContinuousBatcher:
         # drain, leaving a stream whose consumer blocks forever.  RLock:
         # _ctl_call executes control ops INLINE under this lock when no
         # loop thread runs, and _exec_release_prefix re-acquires it
-        self._submit_lock = threading.RLock()
+        self._submit_lock = make_rlock("serving.batcher.submit")
         self._thread: Optional[threading.Thread] = None
         self._step = jax.jit(
             lambda v, t, c, p, pt: self.model.apply(

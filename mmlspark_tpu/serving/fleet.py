@@ -64,6 +64,7 @@ from ..io.http.clients import CircuitBreaker, get_breaker, send_request
 from ..io.http.schema import HTTPRequestData
 from ..utils.faults import fault_point
 from .registry import list_services
+from ..utils.sync import make_lock
 from .server import ServiceInfo
 
 __all__ = ["Replica", "FleetGateway"]
@@ -161,7 +162,7 @@ class FleetGateway:
         self.breaker_reset_s = float(breaker_reset_s)
         self.forward_timeout_s = float(forward_timeout_s)
         self._rng = rng or random.Random()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.fleet.gateway")
         self._replicas: Dict[str, Replica] = {}
         # explicit canary splits (rollout.py); unset versions weigh
         # proportionally to their replicas' registered weights

@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core import telemetry
 from ..io.http.clients import send_request
 from ..io.http.schema import HTTPRequestData
+from ..utils.sync import make_lock
 from .fleet import FleetGateway, Replica
 
 __all__ = ["RolloutController", "ROLLOUT_METRICS"]
@@ -113,7 +114,7 @@ class RolloutController:
         self.last_rows: List[Dict[str, Any]] = []
         self.last_verdict: Optional[str] = None
         self.history: List[dict] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.rollout.manager")
         gateway.rollout = self
 
     # ---- state machine -------------------------------------------------

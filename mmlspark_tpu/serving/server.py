@@ -31,6 +31,7 @@ from ..core import telemetry
 from ..core.schema import Table
 from ..io.http.schema import HTTPRequestData, HTTPResponseData
 from ..core.flow import deadline_expired, deadline_from_ms
+from ..utils.sync import make_lock
 from ..utils.fault_tolerance import Overloaded
 from ..utils.faults import fault_point
 from .journal import EpochJournal
@@ -112,13 +113,13 @@ class WorkerServer:
         # (the graceful half of ServingServer.stop())
         self._draining = threading.Event()
         self.routing: Dict[str, CachedRequest] = {}
-        self._routing_lock = threading.Lock()
+        self._routing_lock = make_lock("serving.server.routing")
         self.handler_timeout = handler_timeout
         # epoch-scoped request history for replay-on-retry + commit GC
         # (HTTPSourceV2.scala historyQueues :488-505, commit :555-567)
         self.epoch = 0
         self.history: Dict[int, List[CachedRequest]] = {}
-        self._epoch_lock = threading.Lock()
+        self._epoch_lock = make_lock("serving.server.epoch")
         # optional disk journal: process-restart persistence (the streaming
         # checkpointLocation analog — see serving/journal.py)
         self.journal = journal

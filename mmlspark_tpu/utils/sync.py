@@ -1,0 +1,64 @@
+"""Named lock construction, hookable by the runtime sanitizer.
+
+Concurrency-bearing modules (core/flow.py, io/feed.py, io/pipeline.py,
+serving/batcher.py, serving/server.py, serving/fleet.py,
+serving/rollout.py, models/guard.py) build their instance locks through
+`make_lock("layer.component")` / `make_rlock(...)` instead of bare
+`threading.Lock()`.  With nothing installed this is a zero-cost alias —
+the returned object IS a `threading.Lock`/`RLock` — but when
+`tools/graftsan` is installed (GRAFTSAN=1, pytest --graftsan, or a
+soak's default) the factory yields instrumented `SanLock`/`SanRLock`
+objects that carry the given name, so lockset race reports (S101) and
+lock-order cycle reports (S201) name `serving.batcher.submit` instead
+of an anonymous `<locked _thread.lock object>`.
+
+The indirection lives in the product tree (not tools/) so production
+code never imports tools/*; graftsan registers itself here at
+install().  `tools/graftlint`'s G2 pass recognizes `make_lock` /
+`make_rlock` assignments as lock definitions for `#: guarded-by`
+validation (G203).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["make_lock", "make_rlock", "set_lock_factory"]
+
+# (lock_factory, rlock_factory) installed by tools.graftsan.install();
+# None = the zero-cost default path.  Plain attribute read + None check
+# per *construction* (not per acquire), so the disabled path costs
+# nothing on lock operations at all.
+_FACTORY: Optional[tuple] = None
+
+
+def set_lock_factory(factory: Optional[tuple]) -> None:
+    """Install `(lock_factory, rlock_factory)` callables taking a
+    `name=` kwarg, or None to restore the plain threading path.  Called
+    by tools/graftsan install()/uninstall() only."""
+    global _FACTORY
+    _FACTORY = factory
+
+
+def make_lock(name: str) -> "threading.Lock":
+    """A mutex named for sanitizer reports; plain `threading.Lock()`
+    unless a sanitizer factory is installed."""
+    f = _FACTORY
+    if f is not None:
+        return f[0](name=name)
+    return threading.Lock()
+
+
+def make_rlock(name: str) -> "threading.RLock":
+    """A reentrant mutex named for sanitizer reports; plain
+    `threading.RLock()` unless a sanitizer factory is installed."""
+    f = _FACTORY
+    if f is not None:
+        return f[1](name=name)
+    return threading.RLock()
+
+
+def lock_factory() -> Optional[tuple]:
+    """The currently installed factory pair (None when disabled) — the
+    sanitizer's own idempotence check reads this."""
+    return _FACTORY

@@ -48,6 +48,20 @@ import numpy as np
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--graftsan", action="store_true", default=False,
+        help="run the whole session under the tools/graftsan runtime "
+             "concurrency sanitizer (same as GRAFTSAN=1); every test "
+             "gets an end-of-test audit and fails on unsuppressed "
+             "S-findings")
+
+
+def _graftsan_requested(config) -> bool:
+    return bool(config.getoption("--graftsan")
+                or os.environ.get("GRAFTSAN", "") not in ("", "0"))
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -57,6 +71,17 @@ def pytest_configure(config):
         "markers",
         "chaos: deterministic seeded fault-injection tests (utils.faults); "
         "fast and tier-1 — chaos here means reproducible, not flaky")
+    if _graftsan_requested(config):
+        import tools.graftsan as graftsan
+
+        graftsan.install()
+
+
+def pytest_unconfigure(config):
+    if _graftsan_requested(config):
+        import tools.graftsan as graftsan
+
+        graftsan.uninstall()
 
 
 # thread-name prefixes owned by serving/batching/training infrastructure;
@@ -70,12 +95,29 @@ _INFRA_PREFIXES = ("serve-", "serving-", "continuous-batcher", "stream-",
 
 
 @pytest.fixture(autouse=True)
-def _no_leaked_serving_threads(request):
+def _end_of_test_checks(request):
+    """One ordered teardown for the per-test invariants.  The graftsan
+    audit MUST run before the thread-leak check: a leaked flow worker
+    usually means a leaked credit, and the sanitizer's S301 names the
+    stage and construction site where the generic leak message can only
+    list thread names."""
     import threading
     import time
 
+    graftsan = None
+    mark = 0
+    if _graftsan_requested(request.config):
+        import tools.graftsan as graftsan
+
+        mark = graftsan.begin_test()
     before = {t.ident for t in threading.enumerate()}
     yield
+    if graftsan is not None:
+        found = graftsan.finish_test(mark)
+        if found:
+            pytest.fail(
+                "graftsan: unsuppressed finding(s):\n" +
+                "\n".join(f.render() for f in found))
     deadline = time.monotonic() + 2.0  # grace: stop() joins may lag
     while time.monotonic() < deadline:
         leaked = [

@@ -283,6 +283,118 @@ class Box:
 """
         assert check_lock_discipline([_sf(src)]) == []
 
+    def test_comprehension_lambda_and_property_bodies_flagged(self):
+        # method-scope comprehensions, lambdas, and @property bodies
+        # are ordinary accesses — each must be seen (the G2 propagation
+        # contract graftsan's S101 shims back-stop dynamically)
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  #: guarded-by self._lock
+
+    def comp(self):
+        return [x for x in self._items]
+
+    def lam(self):
+        return sorted(self._items, key=lambda x: len(self._items))
+
+    @property
+    def snap(self):
+        return tuple(self._items)
+"""
+        found = check_lock_discipline([_sf(src)])
+        assert _rules(found) == ["G202", "G202", "G202", "G202"]
+        assert {f.symbol for f in found} == {"Box.comp", "Box.lam",
+                                             "Box.snap"}
+
+    def test_comprehension_under_lock_is_clean(self):
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  #: guarded-by self._lock
+
+    def comp(self):
+        with self._lock:
+            return [x for x in self._items]
+"""
+        assert check_lock_discipline([_sf(src)]) == []
+
+    def test_class_level_property_lambda_flagged(self):
+        # `snap = property(lambda self: ...)` lives in the class body,
+        # not in cls.methods — the propagation gap this PR closes
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  #: guarded-by self._lock
+
+    snap = property(lambda self: self._items)
+"""
+        found = check_lock_discipline([_sf(src)])
+        assert _rules(found) == ["G202"]
+
+    def test_closure_inside_init_flagged(self):
+        # __init__'s own statements run before the object is shared
+        # (exempt), but a closure it hands to a thread runs after
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  #: guarded-by self._lock
+
+        def probe():
+            return self.n
+
+        self._t = threading.Thread(target=probe, name="box-probe",
+                                   daemon=True)
+"""
+        found = check_lock_discipline([_sf(src)])
+        assert _rules(found) == ["G202"]
+        assert found[0].symbol == "Box.__init__.probe"
+
+    def test_init_direct_assignments_stay_exempt(self):
+        src = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  #: guarded-by self._lock
+        self.n = self.n + 1
+"""
+        assert check_lock_discipline([_sf(src)]) == []
+
+    def test_make_lock_assignment_satisfies_g203(self):
+        # utils.sync.make_lock/make_rlock are the sanitizer-visible
+        # named constructors — same lock for G2's purposes
+        src = """\
+from ..utils.sync import make_lock, make_rlock
+
+class Box:
+    def __init__(self):
+        self._lock = make_lock("fake.box")
+        self._rl = make_rlock("fake.box.r")
+        self.n = 0  #: guarded-by self._lock
+        self.m = 0  #: guarded-by self._rl
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+        with self._rl:
+            self.m += 1
+"""
+        assert check_lock_discipline([_sf(src)]) == []
+
 
 # ------------------------------------------------------------------ G3
 

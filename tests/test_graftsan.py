@@ -1,0 +1,412 @@
+"""graftsan: deliberate-hazard fixtures (each detected DETERMINISTICALLY
+and each quiet under its suppression), install/uninstall reversibility,
+the Eraser negative space, and the repo-clean-under-sanitizer tier-1
+gate (the sanitized flow soak + the empty checked-in baseline).
+
+Determinism: the hazard threads are started and joined SEQUENTIALLY —
+the lockset/lock-order evidence comes from which locks were held at
+each access, not from losing a timing race, so no sleeps are needed and
+the reports fire on every run.  The flow-soak gate runs on the
+VirtualClock like tools/chaos_soak.py --flow does.
+"""
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import tools.graftsan as graftsan  # noqa: E402
+from tools.graftsan import runtime as san_runtime  # noqa: E402
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+def _run_thread(fn):
+    """Run `fn` on a second thread to completion (sequential: the main
+    thread blocks on join, so every interleaving is the same one)."""
+    t = threading.Thread(target=fn, name="graftsan-hazard", daemon=True)
+    t.start()
+    t.join()
+
+
+# ------------------------------------------------------------- S101
+
+class _RacyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  #: guarded-by self._lock
+
+    def bump_unlocked(self):
+        self.n = self.n + 1
+
+    def bump_locked(self):
+        with self._lock:
+            self.n = self.n + 1
+
+
+class _SuppressedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # GIL-atomic by design; the fixture proves the disable works
+        self.n = 0  #: guarded-by self._lock  # graftsan: disable=S101
+
+    def bump_unlocked(self):
+        self.n = self.n + 1
+
+
+class TestS101LocksetRace:
+    def test_two_thread_unsynchronized_counter_detected(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            graftsan.adopt(_RacyCounter)
+            box = _RacyCounter()
+            box.bump_unlocked()            # main: exclusive
+            _run_thread(box.bump_unlocked)  # 2nd thread, no lock: race
+            found = graftsan.take_findings(mark)
+        assert _rules(found) == ["S101"]
+        f = found[0]
+        assert f.symbol == "_RacyCounter.n"
+        assert "guarded-by self._lock" in f.message
+        # both conflicting accesses are named, with their threads
+        assert "graftsan-hazard" in f.message
+        assert "conflicting with" in f.message
+        assert f.path.endswith("tests/test_graftsan.py")
+
+    def test_locked_accesses_are_clean(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            graftsan.adopt(_RacyCounter)
+            box = _RacyCounter()
+            box.bump_locked()
+            _run_thread(box.bump_locked)
+            _run_thread(box.bump_locked)
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+    def test_suppression_on_annotation_line_goes_quiet(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            graftsan.adopt(_SuppressedCounter)
+            box = _SuppressedCounter()
+            box.bump_unlocked()
+            _run_thread(box.bump_unlocked)
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+    def test_report_fires_once_per_field(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            graftsan.adopt(_RacyCounter)
+            box = _RacyCounter()
+            box.bump_unlocked()
+            for _ in range(3):
+                _run_thread(box.bump_unlocked)
+            found = graftsan.take_findings(mark)
+        assert _rules(found) == ["S101"]
+
+
+# ------------------------------------------------------------- S201
+
+class TestS201LockOrder:
+    def test_ab_ba_inversion_detected_without_hanging(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            a = threading.Lock()  # monkeypatched: SanLock
+            b = threading.Lock()
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            # SEQUENTIAL: the cycle is flagged from the order graph the
+            # moment the second edge direction appears — no deadlock is
+            # ever at risk, which is the whole point
+            _run_thread(ab)
+            _run_thread(ba)
+            found = graftsan.take_findings(mark)
+        assert _rules(found) == ["S201"]
+        msg = found[0].message
+        assert "lock-order cycle" in msg
+        # both acquisition stacks ride the report
+        assert msg.count("graftsan-hazard") == 2
+
+    def test_consistent_order_is_clean(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            _run_thread(ab)
+            _run_thread(ab)
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+    def test_suppression_at_lock_creation_site_goes_quiet(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            # documented intentional inversion (e.g. guarded by a
+            # higher-level mutex)
+            a = threading.Lock()  # graftsan: disable=S201
+            b = threading.Lock()
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            _run_thread(ab)
+            _run_thread(ba)
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            r = threading.RLock()  # monkeypatched: SanRLock
+
+            def reenter():
+                with r:
+                    with r:
+                        pass
+
+            _run_thread(reenter)
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+
+# ------------------------------------------------------- S301 / S302
+
+class TestS301CreditConservation:
+    def test_leaked_flow_credit_detected_and_names_the_stage(self):
+        from mmlspark_tpu.core.flow import FlowGraph, Stage
+
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            g = FlowGraph([Stage("leaky", fn=lambda x: x, workers=1)],
+                          queue_size=4)
+            # steal one credit and never release: the hazard a worker
+            # that drops an item without the balancing release would be
+            g._credits[0].acquire(threading.Event())
+            assert list(g.run(range(6))) == list(range(6))
+            graftsan.audit()
+            found = graftsan.take_findings(mark)
+        s301 = [f for f in found if f.rule == "S301"]
+        assert len(s301) == 1
+        f = s301[0]
+        assert "stage 'leaky'" in f.message
+        assert "7 acquired vs 6 released" in f.message
+        assert f.path.endswith("tests/test_graftsan.py")
+
+    def test_clean_graph_is_quiet(self):
+        from mmlspark_tpu.core.flow import FlowGraph, Stage
+
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            g = FlowGraph([Stage("a", fn=lambda x: x + 1, workers=2),
+                           Stage("b", fn=lambda x: x * 2, workers=2)],
+                          queue_size=4)
+            assert list(g.run(range(40))) == [(i + 1) * 2
+                                              for i in range(40)]
+            graftsan.audit()
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+    def test_suppression_at_construction_site_goes_quiet(self):
+        from mmlspark_tpu.core.flow import FlowGraph, Stage
+
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            g = FlowGraph(  # graftsan: disable=S301
+                [Stage("leaky", fn=lambda x: x, workers=1)],
+                queue_size=4)
+            g._credits[0].acquire(threading.Event())
+            assert list(g.run(range(6))) == list(range(6))
+            graftsan.audit()
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+    def test_cancelled_graph_is_not_audited(self):
+        # cancel legitimately strands credits; only CLEAN EOF asserts
+        # the parity contract
+        from mmlspark_tpu.core.flow import FlowGraph, Stage
+
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            g = FlowGraph([Stage("c", fn=lambda x: x, workers=1)],
+                          queue_size=4)
+            it = g.run(range(100))
+            assert next(it) == 0
+            it.close()  # abandons the consumer -> cancel()
+            graftsan.audit()
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+
+class TestS302FaultPointHygiene:
+    def test_leaked_arm_detected(self):
+        from mmlspark_tpu.utils.faults import FAULTS, FaultPlan
+
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            plan = FaultPlan(seed=3)
+            plan.on("flow.decode", probability=0.5)
+            cm = FAULTS.arm(plan)
+            cm.__enter__()  # deliberately never exited before the audit
+            try:
+                graftsan.audit()
+                found = graftsan.take_findings(mark)
+            finally:
+                cm.__exit__(None, None, None)
+        assert _rules(found) == ["S302"]
+        assert "flow.decode" in found[0].message
+
+    def test_structural_arm_is_quiet(self):
+        from mmlspark_tpu.utils.faults import FAULTS, FaultPlan
+
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            plan = FaultPlan(seed=3)
+            plan.on("flow.decode", probability=0.5)
+            with FAULTS.arm(plan):
+                pass
+            graftsan.audit()
+            found = graftsan.take_findings(mark)
+        assert found == []
+
+
+# ------------------------------------------------- install/uninstall
+
+class TestInstallUninstall:
+    def test_patches_applied_and_fully_restored(self):
+        from mmlspark_tpu.core import flow
+        from mmlspark_tpu.utils import sync
+
+        orig_lock, orig_rlock = threading.Lock, threading.RLock
+        was = graftsan.enabled()
+        if was:
+            pytest.skip("session already sanitized (--graftsan)")
+        graftsan.install()
+        try:
+            assert threading.Lock is san_runtime.SanLock
+            assert threading.RLock is san_runtime.SanRLock
+            assert sync.lock_factory() == (san_runtime.SanLock,
+                                           san_runtime.SanRLock)
+            assert flow._SAN is not None
+            assert isinstance(sync.make_lock("t.x"), san_runtime.SanLock)
+            graftsan.install()  # idempotent
+        finally:
+            graftsan.uninstall()
+        assert threading.Lock is orig_lock
+        assert threading.RLock is orig_rlock
+        assert sync.lock_factory() is None
+        assert flow._SAN is None
+        graftsan.uninstall()  # idempotent
+
+    def test_field_values_survive_shim_and_unshim(self):
+        if graftsan.enabled():
+            pytest.skip("session already sanitized (--graftsan)")
+        graftsan.install()
+        try:
+            graftsan.adopt(_RacyCounter)
+            assert isinstance(
+                _RacyCounter.__dict__.get("n"), san_runtime.GuardedField)
+            box = _RacyCounter()
+            box.bump_locked()
+            assert box.n == 1  # through the descriptor
+        finally:
+            graftsan.uninstall()
+        assert "n" not in _RacyCounter.__dict__
+        assert box.n == 1  # same __dict__ key: the value reappears
+
+    def test_condition_and_queue_work_under_monkeypatch(self):
+        # the patch reaches queue mutexes and Condition internals —
+        # they must keep full semantics
+        import queue as queue_mod
+
+        with graftsan.sanitized():
+            q = queue_mod.Queue(maxsize=2)
+            q.put(1)
+            q.put(2)
+            assert q.get() == 1
+            assert q.get() == 2
+            cond = threading.Condition()
+            got = []
+
+            def waiter():
+                with cond:
+                    while not got:
+                        cond.wait(timeout=5.0)
+
+            t = threading.Thread(target=waiter, name="graftsan-cond",
+                                 daemon=True)
+            t.start()
+            with cond:
+                got.append(1)
+                cond.notify_all()
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+
+
+# ------------------------------------------------------ repo gates
+
+class TestRepoCleanUnderSanitizer:
+    def test_checked_in_baseline_is_empty(self):
+        with open(graftsan.default_baseline_path(), encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["findings"] == [], (
+            "the graftsan baseline must stay empty: fix the hazard or "
+            "carry a justified inline suppression instead")
+
+    def test_flow_soak_runs_clean_sanitized(self):
+        # the tier-1 repo-clean gate: the full graftflow chaos soak
+        # (VirtualClock, faults armed at every flow.* point) under the
+        # sanitizer, with zero unsuppressed findings
+        from tools.chaos_soak import run_flow_soak
+
+        with graftsan.sanitized():
+            mark = graftsan.begin_test()
+            summary = run_flow_soak(seed=7, n_items=48)
+            graftsan.audit()
+            found = graftsan.take_findings(mark)
+        assert summary["delivered"] > 0
+        assert found == [], "\n".join(f.render() for f in found)
+
+    def test_report_formats_with_graftlint_parity(self):
+        with graftsan.sanitized():
+            graftsan.take_findings()  # flush strays from this session
+            text, ok = graftsan.report(json_out=True)
+        doc = json.loads(text)
+        assert doc["tool"] == "graftsan"
+        assert ok
+        assert doc["ok"]
+        assert doc["findings"] == []
+        # same schema keys graftlint emits — ci.py --json parity
+        assert set(doc) == {"tool", "findings", "stale_baseline",
+                            "baselined_count", "ok"}
+
+    def test_rule_catalog_covers_all_s_rules(self):
+        assert set(graftsan.S_RULE_DOCS) == {"S101", "S201", "S301",
+                                             "S302"}

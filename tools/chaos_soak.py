@@ -509,6 +509,11 @@ def main(argv=None):
                     help="write the full observability snapshot (spans "
                          "included) to PATH for tools/obs_report.py")
     args = ap.parse_args(argv)
+    import tools.graftsan as graftsan
+
+    # sanitized by default: the soak is exactly the concurrency load the
+    # lockset/credit audits exist for (GRAFTSAN=0 opts out)
+    sanitizing = graftsan.soak_install()
     if args.flow:
         summary = run_flow_soak(seed=args.seed, n_items=args.requests,
                                 max_pending=args.max_pending)
@@ -517,6 +522,14 @@ def main(argv=None):
                            max_queue=args.max_queue, gateway=args.gateway)
     if args.obs_out:
         write_obs_snapshot(args.obs_out)
+    rc = 0
+    san_text = ""
+    if sanitizing:
+        san_text, san_ok = graftsan.report(json_out=args.json)
+        if args.json:
+            summary["graftsan"] = json.loads(san_text)
+        if not san_ok:
+            rc = 1
     if args.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
     elif args.flow:
@@ -535,7 +548,9 @@ def main(argv=None):
               f"recoveries={summary['recoveries']} "
               f"replayed={summary['replayed']} "
               f"feed_degraded={summary['feed_degraded']}")
-    return 0
+    if sanitizing and not args.json:
+        print(san_text)
+    return rc
 
 
 if __name__ == "__main__":

@@ -11,6 +11,9 @@ anywhere:
                                             # bench regression gate
     python tools/ci.py fleet-smoke          # gateway kill/revive soak
     python tools/ci.py flow-soak            # graftflow runtime chaos soak
+    python tools/ci.py sanitize [--json]    # all soaks under GRAFTSAN=1
+                                            # (tools/graftsan runtime
+                                            # concurrency sanitizer)
     python tools/ci.py test [--shards N] [--shard K] [--retries R]
     python tools/ci.py all                  # lint + every shard
 
@@ -310,11 +313,45 @@ def flow_soak(timeout_s: int = 300) -> int:
     return rc
 
 
+def sanitize(timeout_s: int = 300, json_out: bool = False) -> int:
+    """Run every soak under the runtime concurrency sanitizer
+    (tools/graftsan, GRAFTSAN=1): chaos_soak --flow and --gateway,
+    fleet_soak, train_soak.  Each job fails on any unsuppressed S-rule
+    finding (lockset race S101, lock-order cycle S201, credit/EOF leak
+    S301, leaked fault-point arm S302) not excused by the checked-in —
+    and deliberately empty — tools/graftsan_baseline.json."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", GRAFTSAN="1")
+    jobs = [
+        ("chaos-flow", [os.path.join("tools", "chaos_soak.py"), "--flow"]),
+        ("chaos-gateway", [os.path.join("tools", "chaos_soak.py"),
+                           "--gateway"]),
+        ("fleet", [os.path.join("tools", "fleet_soak.py")]),
+        ("train", [os.path.join("tools", "train_soak.py")]),
+    ]
+    failures = 0
+    for name, cmd in jobs:
+        full = [sys.executable] + cmd + (["--json"] if json_out else [])
+        print(f"== sanitize: {name}")
+        try:
+            rc = subprocess.call(full, cwd=ROOT, env=env,
+                                 timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            print(f"sanitize[{name}] timed out after {timeout_s}s")
+            rc = 1
+        if rc != 0:
+            failures += 1
+        print(f"sanitize[{name}]:", "OK" if rc == 0 else f"FAILED (rc={rc})")
+    print("sanitize:", "OK" if not failures
+          else f"{failures} job(s) FAILED")
+    return 1 if failures else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("command", choices=["lint", "metrics-lint", "test",
                                         "perf-gate", "fleet-smoke",
-                                        "train-soak", "flow-soak", "all"])
+                                        "train-soak", "flow-soak",
+                                        "sanitize", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
@@ -346,6 +383,8 @@ def main(argv=None):
         return train_smoke()
     if args.command == "flow-soak":
         return flow_soak()
+    if args.command == "sanitize":
+        return sanitize(json_out=args.json)
     if args.command == "test":
         return test(args.shards, args.shard, args.retries, args.timeout)
     rc = lint()

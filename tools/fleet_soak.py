@@ -225,13 +225,27 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="print the summary as JSON")
     args = ap.parse_args(argv)
+    import tools.graftsan as graftsan
+
+    # sanitized by default (GRAFTSAN=0 opts out)
+    sanitizing = graftsan.soak_install()
     report = run_soak(seed=args.seed, n_requests=args.requests,
                       n_replicas=args.replicas)
+    rc = 0
+    san_text = ""
+    if sanitizing:
+        san_text, san_ok = graftsan.report(json_out=args.json)
+        if args.json:
+            report["graftsan"] = json.loads(san_text)
+        if not san_ok:
+            rc = 1
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
         print("fleet-soak OK:", report)
-    return 0
+        if sanitizing:
+            print(san_text)
+    return rc
 
 
 if __name__ == "__main__":

@@ -94,23 +94,38 @@ def _collect_class(sf: SourceFile, node: ast.ClassDef) -> _ClassInfo:
                         tail = f.attr
                     elif isinstance(f, ast.Name):
                         tail = f.id
-                    if tail in ("Lock", "RLock", "Condition"):
+                    if tail in ("Lock", "RLock", "Condition",
+                                "make_lock", "make_rlock"):
+                        # utils.sync.make_lock/make_rlock are the named
+                        # constructors the runtime sanitizer hooks —
+                        # same lock, graftsan-visible name
                         info.locks.add(attr)
     return info
 
 
 def _walk_method(sf: SourceFile, cls: _ClassInfo, mname: str,
                  method: ast.AST, locked_methods: Set[str],
-                 findings: List[Finding]) -> None:
+                 findings: List[Finding],
+                 report_top: bool = True) -> None:
     """Flag guarded-attribute accesses outside their lock's with-block.
 
     `locked_methods`: methods whose every intra-class call site holds
-    the relevant lock — their bodies count as lock-held."""
+    the relevant lock — their bodies count as lock-held.
+    `report_top=False` reports only accesses inside NESTED function/
+    lambda scopes (the __init__ mode: the constructor body runs before
+    the object is shared, but a closure it defines and hands to a
+    thread/callback runs after)."""
     base_held: frozenset = (
         frozenset(cls.guarded.values()) if mname in locked_methods
         else frozenset())
 
-    def visit(node: ast.AST, held: frozenset):
+    def visit(node: ast.AST, held: frozenset,
+              in_nested: bool = report_top):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, True)
+            return
         if isinstance(node, ast.With):
             newly = set()
             for item in node.items:
@@ -119,13 +134,14 @@ def _walk_method(sf: SourceFile, cls: _ClassInfo, mname: str,
                     newly.add(attr)
             inner = held | frozenset(newly)
             for item in node.items:
-                visit(item.context_expr, held)
+                visit(item.context_expr, held, in_nested)
             for child in node.body:
-                visit(child, inner)
+                visit(child, inner, in_nested)
             return
         if isinstance(node, ast.Attribute):
             attr = _self_attr(node)
-            if attr in cls.guarded and cls.guarded[attr] not in held:
+            if attr in cls.guarded and cls.guarded[attr] not in held \
+                    and in_nested:
                 write = isinstance(node.ctx, (ast.Store, ast.Del))
                 rule = "G201" if write else "G202"
                 if not sf.suppressed(rule, node.lineno):
@@ -144,9 +160,11 @@ def _walk_method(sf: SourceFile, cls: _ClassInfo, mname: str,
         # held set, which is correct for `with lock: def f(): ...` and
         # conservative for closures called elsewhere
         for child in ast.iter_child_nodes(node):
-            visit(child, held)
+            visit(child, held, in_nested)
 
-    for stmt in method.body:  # type: ignore[attr-defined]
+    # a Lambda's body is a single expression, not a statement list
+    body = method.body if isinstance(method.body, list) else [method.body]
+    for stmt in body:
         visit(stmt, base_held)
 
 
@@ -209,7 +227,27 @@ def check_lock_discipline(files: Sequence[SourceFile]) -> List[Finding]:
                 and all(set(cls.guarded.values()) <= h for h in helds)}
             for mname, method in sorted(cls.methods.items()):
                 if mname == "__init__":
+                    # the constructor body runs before the object is
+                    # shared — but closures/lambdas it DEFINES (thread
+                    # targets, callbacks) run after, so those still get
+                    # checked
+                    _walk_method(sf, cls, mname, method, locked_methods,
+                                 findings, report_top=False)
                     continue
                 _walk_method(sf, cls, mname, method, locked_methods,
                              findings)
+            # class-level lambdas never live in cls.methods:
+            #   snap = property(lambda self: self._items)
+            # walk every lambda in a class-body assignment as if it
+            # were a method of its own
+            for child in node.body:
+                if not isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if child.value is None:
+                    continue
+                for sub in ast.walk(child.value):
+                    if isinstance(sub, ast.Lambda) and sub.args.args \
+                            and sub.args.args[0].arg == "self":
+                        _walk_method(sf, cls, f"<lambda:{sub.lineno}>",
+                                     sub, locked_methods, findings)
     return findings

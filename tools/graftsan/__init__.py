@@ -1,0 +1,214 @@
+"""graftsan: the runtime concurrency sanitizer (install surface).
+
+graftlint (tools/graftlint) checks what is lexically visible; this
+package supplies the execution-time evidence for the same invariants —
+S101 lockset races, S201 lock-order cycles, S301/S302 credit and
+fault-point conservation (see runtime.py for the analyses, and
+docs/static_analysis.md "Dynamic analyses" for the catalog and the
+G2-vs-S101 division of labor).
+
+Entry points:
+
+* ``GRAFTSAN=1`` env, or ``pytest --graftsan`` — tests/conftest.py
+  installs at session start and audits after every test.
+* the soaks (tools/chaos_soak.py, fleet_soak.py, train_soak.py) install
+  by default (``GRAFTSAN=0`` opts out) and fail on unsuppressed
+  findings.
+* ``python -m tools.ci sanitize`` — the CI entry: all three soaks
+  sanitized, zero unsuppressed findings required.
+
+install() does three reversible things: monkeypatches
+``threading.Lock``/``RLock`` with the instrumented drop-ins, registers
+the named-lock factory with ``mmlspark_tpu.utils.sync`` (so adopted
+sites get locks named ``serving.batcher.submit`` instead of anonymous
+mutexes), and shims the ``#: guarded-by`` annotated fields of the
+concurrency-bearing classes with Eraser access checks.  uninstall()
+restores every one of them; instances created while installed keep
+working either way.
+
+Findings ride graftlint's Finding/suppression/baseline machinery:
+``# graftsan: disable=SXXX`` on (or above) the reported line suppresses,
+``tools/graftsan_baseline.json`` is the ratchet file (checked in EMPTY —
+the repo runs clean under its own sanitizer), and report() renders
+through graftlint's formatter for ``--json`` parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import List, Optional, Tuple
+
+from tools.graftlint.core import (Finding, apply_baseline, format_findings,
+                                  load_baseline)
+
+from . import runtime
+from .runtime import (S_RULE_DOCS, STATE, SanLock, SanRLock, audit_fault_points,
+                      audit_flow, shim_guarded_fields, unshim_guarded_fields)
+
+__all__ = ["install", "uninstall", "enabled", "sanitized", "adopt",
+           "begin_test", "finish_test", "take_findings", "audit",
+           "report", "default_baseline_path", "S_RULE_DOCS",
+           "SanLock", "SanRLock", "STATE"]
+
+_ORIG: Optional[Tuple[type, type]] = None  # (threading.Lock, RLock)
+_OBSERVER: Optional[runtime.FlowObserver] = None
+_SHIMMED: List[type] = []
+
+
+def _shim_classes() -> List[type]:
+    """The concurrency-bearing classes whose `#: guarded-by` fields get
+    Eraser shims.  Instances whose guard lock predates install (module
+    singletons) are skipped at access time, so listing a class here is
+    safe even when one of its instances is import-time global."""
+    from mmlspark_tpu.core import flow
+    from mmlspark_tpu.core.telemetry import metrics
+    from mmlspark_tpu.io import pipeline
+    from mmlspark_tpu.models import guard
+    from mmlspark_tpu.utils import faults
+
+    return [flow._Reorder, flow.FlowGraph,
+            pipeline.PipelineTelemetry,
+            metrics.Gauge, metrics.MetricsRegistry,
+            guard.TrainingGuard,
+            faults.VirtualClock, faults.FaultInjector]
+
+
+def enabled() -> bool:
+    return _ORIG is not None
+
+
+def install() -> None:
+    """Switch the sanitizer on (idempotent)."""
+    global _ORIG, _OBSERVER
+    if _ORIG is not None:
+        return
+    from mmlspark_tpu.core import flow
+    from mmlspark_tpu.utils import sync
+
+    _ORIG = (threading.Lock, threading.RLock)
+    threading.Lock = SanLock        # monkeypatch: queue mutexes,
+    threading.RLock = SanRLock      # Conditions, Events, Semaphores
+    sync.set_lock_factory((SanLock, SanRLock))
+    _OBSERVER = runtime.FlowObserver()
+    flow.set_sanitizer(_OBSERVER)
+    _SHIMMED.clear()
+    for cls in _shim_classes():
+        if shim_guarded_fields(cls):
+            _SHIMMED.append(cls)
+    STATE.enabled = True
+
+
+def uninstall(reset: bool = True) -> None:
+    """Switch the sanitizer off and restore every patch (idempotent).
+    `reset=False` keeps accumulated findings readable after teardown."""
+    global _ORIG, _OBSERVER
+    if _ORIG is None:
+        return
+    from mmlspark_tpu.core import flow
+    from mmlspark_tpu.utils import sync
+
+    STATE.enabled = False
+    threading.Lock, threading.RLock = _ORIG
+    _ORIG = None
+    sync.set_lock_factory(None)
+    flow.set_sanitizer(None)
+    _OBSERVER = None
+    for cls in _SHIMMED:
+        unshim_guarded_fields(cls)
+    _SHIMMED.clear()
+    if reset:
+        STATE.reset()
+
+
+def adopt(cls: type) -> type:
+    """Shim one extra class's `#: guarded-by` fields (test fixtures,
+    downstream subsystems).  No-op unless installed; returns `cls` so it
+    works as a decorator."""
+    if _ORIG is not None and shim_guarded_fields(cls):
+        _SHIMMED.append(cls)
+    return cls
+
+
+def soak_install() -> bool:
+    """The soaks sanitize BY DEFAULT — concurrency tooling that must be
+    opted into never runs when it matters.  ``GRAFTSAN=0`` opts out
+    (e.g. when bisecting a soak failure against the sanitizer itself);
+    returns True when sanitizing."""
+    if os.environ.get("GRAFTSAN", "1") == "0":
+        return False
+    install()
+    return True
+
+
+@contextlib.contextmanager
+def sanitized():
+    """Run a block under the sanitizer, restoring the prior state after
+    — the deliberate-hazard fixtures use this so they detect under plain
+    tier-1 runs too, not only under --graftsan sessions."""
+    was = enabled()
+    if not was:
+        install()
+    try:
+        yield
+    finally:
+        if not was:
+            uninstall(reset=False)
+
+
+# ---------------------------------------------------------------------------
+# per-test / per-soak audit surface
+# ---------------------------------------------------------------------------
+def begin_test() -> int:
+    """Mark the findings high-water before a test; finish_test(mark)
+    audits and returns only that test's new findings."""
+    with STATE.lock:
+        return len(STATE.findings)
+
+
+def audit() -> None:
+    """Run the end-of-scope sweeps (flow credit parity on clean-EOF
+    graphs that were never drained, leaked fault-point arms)."""
+    audit_flow()
+    audit_fault_points()
+
+
+def finish_test(mark: int) -> List[Finding]:
+    audit()
+    with STATE.lock:
+        return list(STATE.findings[mark:])
+
+
+def take_findings(mark: int = 0) -> List[Finding]:
+    """Remove and return findings[mark:] — the deliberate-hazard tests
+    assert on (and consume) their own reports so the session-end audit
+    stays clean."""
+    with STATE.lock:
+        taken = list(STATE.findings[mark:])
+        del STATE.findings[mark:]
+        for key in STATE.finding_keys[mark:]:
+            STATE.seen.discard(key)
+        del STATE.finding_keys[mark:]
+        return taken
+
+
+# ---------------------------------------------------------------------------
+# reporting (graftlint parity)
+# ---------------------------------------------------------------------------
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "graftsan_baseline.json")
+
+
+def report(json_out: bool = False,
+           baseline_path: Optional[str] = None) -> Tuple[str, bool]:
+    """Render accumulated findings against the graftsan baseline;
+    returns (text, ok).  Same formatter as graftlint, tool-tagged, so
+    `tools/ci.py sanitize --json` mirrors `lint --json`."""
+    audit()
+    with STATE.lock:
+        findings = list(STATE.findings)
+    baseline = load_baseline(baseline_path or default_baseline_path())
+    res = apply_baseline(findings, baseline)
+    return (format_findings(res, json_out=json_out, tool="graftsan"),
+            not (res.new or res.stale))

@@ -1,0 +1,95 @@
+"""CLI: `python -m tools.graftsan` — rule catalog and a smoke check.
+
+The real entry points are `pytest --graftsan` / `GRAFTSAN=1` (tests),
+the three soaks (sanitized by default), and `python -m tools.ci
+sanitize` (CI).  This module exists so the rule catalog is one command
+away and so `--selftest` gives a fast local proof that the detectors
+fire (it deliberately provokes one S101 and one S201 in-process and
+verifies both reports)."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _selftest() -> int:
+    import threading
+
+    import tools.graftsan as graftsan
+
+    graftsan.install()
+    mark = graftsan.begin_test()
+
+    class Racy:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0  #: guarded-by self._lock
+
+    graftsan.adopt(Racy)
+    box = Racy()
+
+    def bump():
+        box.n = box.n + 1  # no lock: the hazard
+
+    t = threading.Thread(target=bump, name="graftsan-selftest", daemon=True)
+    t.start()
+    t.join()
+    box.n = box.n + 1
+
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab, name="graftsan-selftest-ab", daemon=True)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba, name="graftsan-selftest-ba", daemon=True)
+    t2.start()
+    t2.join()
+
+    found = graftsan.take_findings(mark)
+    rules = {f.rule for f in found}
+    graftsan.uninstall()
+    ok = "S101" in rules and "S201" in rules
+    print("graftsan selftest:", "ok" if ok else
+          f"FAILED (got {sorted(rules) or 'nothing'})")
+    for f in found:
+        print(" ", f.render())
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.graftsan",
+        description="runtime concurrency sanitizer (rule catalog / "
+                    "selftest); run it via pytest --graftsan, the "
+                    "soaks, or tools/ci.py sanitize")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the S-rule catalog")
+    ap.add_argument("--selftest", action="store_true",
+                    help="provoke one S101 and one S201 in-process and "
+                         "verify both fire")
+    args = ap.parse_args(argv)
+    if args.rules:
+        from .runtime import S_RULE_DOCS
+
+        for rule in sorted(S_RULE_DOCS):
+            print(f"{rule}  {S_RULE_DOCS[rule]}")
+        return 0
+    if args.selftest:
+        return _selftest()
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,10 @@ GATE_METRICS: Dict[str, Tuple[str, float, float]] = {
     # overhead, absolute band (the base fraction hovers near zero, so a
     # relative tolerance would be meaningless)
     "guard_overhead_frac": ("lower", 0.0, 0.01),
+    # the graftsan-disabled flow runtime's hook contract: the `_SAN is
+    # None` branches + make_lock indirection cost <1% per item, absolute
+    # band for the same near-zero-base reason
+    "sanitizer_overhead_frac": ("lower", 0.0, 0.01),
 }
 
 

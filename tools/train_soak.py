@@ -270,6 +270,10 @@ def main(argv=None):
                     help="write the full observability snapshot to PATH "
                          "for tools/obs_report.py")
     args = ap.parse_args(argv)
+    import tools.graftsan as graftsan
+
+    # sanitized by default (GRAFTSAN=0 opts out)
+    sanitizing = graftsan.soak_install()
     t0 = time.monotonic()
     with tempfile.TemporaryDirectory() as tmp:
         work = args.workdir or tmp
@@ -279,6 +283,14 @@ def main(argv=None):
                "wall_s": round(time.monotonic() - t0, 2)}
     if args.obs_out:
         write_obs_snapshot(args.obs_out)
+    rc = 0
+    san_text = ""
+    if sanitizing:
+        san_text, san_ok = graftsan.report(json_out=args.json)
+        if args.json:
+            summary["graftsan"] = json.loads(san_text)
+        if not san_ok:
+            rc = 1
     if args.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
     else:
@@ -290,7 +302,9 @@ def main(argv=None):
               f"{chaos['final_loss']:.4f} "
               f"({chaos['step_crossings']}/{chaos['crossing_bound']} "
               f"step crossings) in {summary['wall_s']}s")
-    return 0
+    if sanitizing and not args.json:
+        print(san_text)
+    return rc
 
 
 if __name__ == "__main__":
