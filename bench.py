@@ -626,6 +626,14 @@ def _measure(e2e_n: int, batch: int, iters: int) -> dict:
     out = {
         "value": round(e2e_ips, 1),
         "forward_ips": round(forward_ips, 1),
+        # the h2d-wall headline (ISSUE 14): how much of the jitted
+        # forward's throughput the full pipeline delivers — 1.0 means the
+        # feed costs nothing; BENCH_LASTGOOD's h2d-bound runs sit ~0.03
+        "e2e_over_forward_frac": (round(e2e_ips / forward_ips, 4)
+                                  if forward_ips > 0 else None),
+        # which transfer path the timed transforms took
+        # (sharded | coalesced | fallback)
+        "h2d_path": feed["h2d_path"],
         "mfu": round(mfu, 4) if mfu is not None else None,
         "overlap_frac": feed["overlap_frac"],
         "stall_s": feed["stall_s"],
@@ -851,6 +859,7 @@ def main():
         "forward_ips": res["forward_ips"],
         "mfu": res["mfu"],
         **{k: res[k] for k in ("decode_ips", "h2d_gbps", "h2d_ips",
+                               "h2d_path", "e2e_over_forward_frac",
                                "overlap_frac", "stall_s", "feed_gbps",
                                "feed_transfer_calls", "feed_transfer_p95_ms",
                                "steady_recompiles", "hbm_bytes_in_use",

@@ -44,6 +44,12 @@ DECLARED_METRICS: Dict[str, str] = {
     "batcher.deadline_expired": "counter",
     "feed.transfer_retry": "counter",
     "feed.degraded": "counter",
+    # -- counters: the sharded direct-to-chip path (io/shard_put.py, PR 14)
+    "feed.shard_retry": "counter",
+    "feed.shard_degraded": "counter",
+    "io.feed.shard.puts": "counter",
+    "io.feed.shard.fallback": "counter",
+    "io.feed.shard.compressed_groups": "counter",
     "circuit.open": "counter",            # + .<breaker-name> variants
     "circuit.closed": "counter",
     "circuit.half_open_probe": "counter",
@@ -87,6 +93,8 @@ DECLARED_METRICS: Dict[str, str] = {
     "serving.batcher.batch_fill": "histogram",
     "io.feed.transfer.latency": "histogram",
     "io.feed.transfer.bytes": "histogram",
+    "io.feed.shard.latency": "histogram",   # one observation per shard put
+    "io.feed.shard.bytes": "histogram",
     "io.pipeline.stage.latency": "histogram",   # labeled {stage=...}
     "flow.stage.latency": "histogram",          # labeled {stage=...}
     "io.http.request.latency": "histogram",
@@ -102,6 +110,9 @@ DECLARED_METRICS: Dict[str, str] = {
     "io.feed.overlap_frac": "gauge",
     "io.feed.stall_s": "gauge",
     "io.feed.queue.depth": "gauge",
+    "io.feed.shard.concurrency": "gauge",   # pool in-flight high-water
+    "io.feed.shard.wire_ratio": "gauge",    # raw/sent on the RLE wire
+    "io.feed.shard.queue.depth": "gauge",   # transfer-pool task backlog
     "io.pipeline.queue.depth": "gauge",   # + .<stage> variants
     "flow.queue.depth": "gauge",          # + .<stage> variants
     "flow.queue.depth.admission": "gauge",

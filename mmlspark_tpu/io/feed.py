@@ -52,9 +52,29 @@ is amortized the same way, JAX-first:
     hop as an `H2DStage` for credit-bounded FlowGraphs
     (decode -> assemble -> h2d), with the `flow.h2d` fault point and
     declared `flow.*.h2d` telemetry (lint rule G405).
+  * **Sharded direct-to-chip transfers.**  On a multi-device mesh a
+    single monolithic `device_put` serializes the whole batch through
+    one transfer stream; with `shard_strategy="auto"` (the default) the
+    feed hands evenly-divisible sharded puts to `io.shard_put.
+    ShardEngine` — one concurrent per-device transfer per addressable
+    shard, staged through pre-pinned size-bucketed buffers, assembled
+    zero-copy with `make_array_from_single_device_arrays`.  Each shard
+    rides the `feed.shard_put` fault point behind its own StagePolicy
+    rung; a shard group that exhausts its retries falls back to the
+    coalesced single-put path and the engine stays there
+    (`shard_degraded`, one rung above the PR-2 ladder).  Non-divisible
+    batches fall back per call (`h2d_path="fallback"` in bench).
+  * **Compressed wire.**  `put_group` accepts `ops.wire_codec.
+    RLEPayload` items (still-encoded byte-RLE chunks + a cumulative
+    length table): the wire carries values+ends only — 2-20x fewer
+    bytes on runnable pixel data — and the chunk is re-expanded ON
+    DEVICE (Pallas page-walk kernel on TPU, `jnp.repeat` everywhere
+    else; transparent fallback rung).  Tune all three knobs with
+    `tools/feed_tune.py`; the winner persists via MMLSPARK_FEED_TUNED.
 """
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -71,7 +91,13 @@ from ..utils.faults import fault_point
 from ..utils.sync import make_lock
 
 __all__ = ["DeviceFeed", "H2DStage", "FeedTelemetry", "FEED_TELEMETRY",
-           "default_depth", "FeedSource", "FEED_END"]
+           "default_depth", "FeedSource", "FEED_END", "FEED_FAULT_POINTS",
+           "load_tuned"]
+
+# every fault point the feed engine can cross — chaos_soak enumerates
+# this alongside flow_fault_points() so its full-coverage plan can never
+# go stale when a transfer path gains a new point
+FEED_FAULT_POINTS = ("feed.device_put", "feed.shard_put")
 
 _ALIGN = 128  # byte-pack offset alignment (covers every feed dtype's itemsize)
 
@@ -163,6 +189,32 @@ def default_depth() -> int:
         return 2
 
 
+_TUNED_LOCK = make_lock("io.feed.tuned")
+_TUNED_CACHE: Dict[str, Dict[str, Any]] = {}  #: guarded-by _TUNED_LOCK
+
+
+def load_tuned() -> Dict[str, Any]:
+    """The autotuned feed config (`tools/feed_tune.py` winner), read from
+    the MMLSPARK_FEED_TUNED path once per process.  Keys: `depth`,
+    `coalesce`, `strategy` — DeviceFeed consults them for any knob the
+    caller left at None.  A missing/corrupt file is an empty config, not
+    an error: tuning is an optimization, never a dependency."""
+    path = os.environ.get("MMLSPARK_FEED_TUNED", "")
+    if not path:
+        return {}
+    with _TUNED_LOCK:
+        cfg = _TUNED_CACHE.get(path)
+        if cfg is None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                cfg = doc if isinstance(doc, dict) else {}
+            except (OSError, ValueError):
+                cfg = {}
+            _TUNED_CACHE[path] = cfg
+        return cfg
+
+
 class FeedTelemetry:
     """Thread-safe monotonic counters for the feed engine.
 
@@ -176,16 +228,31 @@ class FeedTelemetry:
 
     _FIELDS = ("bytes_moved", "transfer_calls", "transfer_s", "chunks_fed",
                "coalesced_chunks", "groups", "stall_decode_s",
-               "stall_drain_s", "compute_s", "wall_s")
+               "stall_drain_s", "compute_s", "wall_s",
+               # the sharded direct-to-chip path (io/shard_put.py)
+               "sharded_groups", "fallback_groups", "shard_puts",
+               "shard_bytes", "shard_wall_s", "shard_put_s",
+               # the compressed wire path (ops/wire_codec.py)
+               "compressed_groups", "wire_bytes_raw", "wire_bytes_sent")
+    # high-water marks, not sums (note_max; delta reports the mark itself)
+    _MAX_FIELDS = ("transfer_concurrency",)
 
     def __init__(self):
         self._lock = make_lock("io.feed.telemetry")
         self._c: Dict[str, float] = {f: 0.0 for f in self._FIELDS}
+        self._c.update({f: 0.0 for f in self._MAX_FIELDS})
 
     def add(self, **kw: float):
         with self._lock:
             for k, v in kw.items():
                 self._c[k] += v
+
+    def note_max(self, **kw: float):
+        """Raise high-water fields (`_MAX_FIELDS`) to at least `kw`."""
+        with self._lock:
+            for k, v in kw.items():
+                if v > self._c[k]:
+                    self._c[k] = v
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -193,7 +260,8 @@ class FeedTelemetry:
 
     def delta(self, since: Dict[str, float]) -> Dict[str, float]:
         now = self.snapshot()
-        return {k: now[k] - since.get(k, 0.0) for k in now}
+        return {k: (now[k] if k in self._MAX_FIELDS
+                    else now[k] - since.get(k, 0.0)) for k in now}
 
     @staticmethod
     def summarize(d: Dict[str, float]) -> Dict[str, Any]:
@@ -217,12 +285,34 @@ class FeedTelemetry:
             "h2d_gbps": (round(d["bytes_moved"] / d["transfer_s"] / 1e9, 4)
                          if d.get("transfer_s", 0) > 0 else None),
         }
+        # the sharded-path breakdown (ISSUE 14): which transfer path the
+        # window actually took, its per-shard bandwidth, and the transfer
+        # pool's concurrency high-water
+        sharded = int(d.get("sharded_groups", 0))
+        fallback = int(d.get("fallback_groups", 0))
+        if sharded > 0 and sharded >= fallback:
+            out["h2d_path"] = "sharded"
+        elif fallback > 0:
+            out["h2d_path"] = "fallback"
+        else:
+            out["h2d_path"] = "coalesced"
+        out["shard_gbps"] = (
+            round(d["shard_bytes"] / d["shard_wall_s"] / 1e9, 4)
+            if d.get("shard_wall_s", 0) > 0 else None)
+        out["transfer_concurrency"] = (
+            int(d.get("transfer_concurrency", 0)) or None)
+        sent = d.get("wire_bytes_sent", 0.0)
+        out["wire_ratio"] = (round(d.get("wire_bytes_raw", 0.0) / sent, 3)
+                             if sent > 0 else None)
         # mirror the derived numbers onto the registry so /metrics and
         # export_snapshot() carry the latest feed summary
         core_telemetry.gauge("io.feed.stall_s").set(out["stall_s"])
         if out["overlap_frac"] is not None:
             core_telemetry.gauge("io.feed.overlap_frac").set(
                 out["overlap_frac"])
+        if out["wire_ratio"] is not None:
+            core_telemetry.gauge("io.feed.shard.wire_ratio").set(
+                out["wire_ratio"])
         return out
 
 
@@ -261,15 +351,42 @@ class DeviceFeed:
     """
 
     def __init__(self, mesh=None, depth: Optional[int] = None,
-                 coalesce: int = 4, coalesce_bytes: int = 64 << 20,
+                 coalesce: Optional[int] = None,
+                 coalesce_bytes: int = 64 << 20,
                  telemetry: Optional[FeedTelemetry] = None,
-                 transfer_retries: int = 3):
+                 transfer_retries: int = 3,
+                 shard_strategy: Optional[str] = None):
+        tuned = load_tuned()
         self.mesh = mesh
-        self.depth = max(1, int(depth if depth is not None else default_depth()))
+        if depth is None:
+            depth = tuned.get("depth") or default_depth()
+        self.depth = max(1, int(depth))
+        if coalesce is None:
+            coalesce = tuned.get("coalesce") or 4
         self.coalesce = max(1, int(coalesce))
         self.coalesce_bytes = int(coalesce_bytes)
         self.telemetry = telemetry if telemetry is not None else FEED_TELEMETRY
         self.transfer_retries = max(1, int(transfer_retries))
+        # sharded-path strategy: explicit arg > env > autotuned > auto.
+        # "auto"/"sharded" route evenly-divisible multi-device puts
+        # through ShardEngine; "coalesced" pins the PR-2 single-put path;
+        # "compressed" additionally advertises the RLE wire to consumers
+        # that ask (`prefers_compressed`).
+        if shard_strategy is None:
+            shard_strategy = (os.environ.get("MMLSPARK_FEED_SHARD")
+                              or tuned.get("strategy") or "auto")
+        if shard_strategy not in ("auto", "sharded", "coalesced",
+                                  "compressed"):
+            raise ValueError(f"unknown shard_strategy {shard_strategy!r}")
+        self.shard_strategy = shard_strategy
+        # a shard group that exhausted its retries flips this: the feed
+        # stays on the coalesced single-put path for the rest of its
+        # life (same sticky shape as `degraded`, one rung above it)
+        self.shard_degraded = False
+        self._shard_engine = None
+        self._shard_policy = StagePolicy(retries=self.transfer_retries,
+                                         backoff_s=0.001, backoff_cap_s=0.05,
+                                         retry_counter="feed.shard_retry")
         # the retry rungs of the degradation ladder, as the shared
         # StagePolicy shape (core/flow.py); the terminal degrade rung
         # stays at the call sites, which know whether the failed put was
@@ -330,6 +447,71 @@ class DeviceFeed:
             warnings.warn(f"DeviceFeed degraded to unpipelined transfers: {why}",
                           RuntimeWarning, stacklevel=3)
 
+    # ---- the sharded direct-to-chip path (io/shard_put.py) -------------
+    def _engine(self):
+        if self._shard_engine is None:
+            from .shard_put import ShardEngine
+
+            # an explicit "sharded" strategy is a directive, not a hint:
+            # drop the per-shard size floor so even small batches (tests,
+            # the autotuner's sweeps) take the per-device path
+            floor = 0 if self.shard_strategy == "sharded" else 1 << 12
+            self._shard_engine = ShardEngine(policy=self._shard_policy,
+                                             telemetry=self.telemetry,
+                                             min_shard_bytes=floor)
+        return self._shard_engine
+
+    def _degrade_shard(self, why: str):
+        """The shard rung of the ladder: sticky per-feed fall-back to the
+        coalesced single-put path (which keeps ITS retry/degrade rungs)."""
+        if not self.shard_degraded:
+            self.shard_degraded = True
+            core_telemetry.incr("feed.shard_degraded")
+            warnings.warn(
+                f"DeviceFeed sharded path degraded to coalesced: {why}",
+                RuntimeWarning, stacklevel=3)
+
+    def _try_sharded(self, arr: np.ndarray, sharding):
+        """`arr` through the sharded engine, or None when this put is not
+        eligible (strategy, degraded, uneven batch, single target) — the
+        caller continues on the coalesced path.  Ineligibility of a
+        genuinely multi-device put is counted as a fallback group: that
+        is the `h2d_path="fallback"` signal bench and feed_bench report."""
+        from .shard_put import ShardTransferError
+
+        if self.shard_degraded or self.shard_strategy == "coalesced":
+            return None
+        if sharding is None:
+            return None
+        from .shard_put import shard_layout
+
+        eng = self._engine()
+        layout = shard_layout(sharding, arr.shape)
+        if layout is None or len(layout) <= 1:
+            # uneven batch (or a single-target sharding): only the former
+            # is a genuine fall-off of the sharded path
+            try:
+                multi = len(sharding.addressable_devices) > 1
+            except (AttributeError, TypeError):
+                multi = False
+            if multi:
+                self.telemetry.add(fallback_groups=1)
+                core_telemetry.incr("io.feed.shard.fallback")
+            return None
+        if arr.nbytes // len(layout) < eng.min_shard_bytes:
+            # below the per-shard floor the fixed per-put cost wins:
+            # coalescing is the DELIBERATE choice here, not a fallback
+            return None
+        try:
+            out = eng.put_sharded(arr, sharding, layout)
+        except ShardTransferError as e:
+            self._degrade_shard(f"shard put failed after retries: {e}")
+            self.telemetry.add(fallback_groups=1)
+            core_telemetry.incr("io.feed.shard.fallback")
+            return None
+        self.telemetry.add(chunks_fed=1, groups=1)
+        return out
+
     # ---- sharding helpers ----------------------------------------------
     def _dp(self) -> int:
         return self.mesh.shape["data"] if self.mesh is not None else 1
@@ -352,10 +534,17 @@ class DeviceFeed:
     # ---- single transfers ----------------------------------------------
     def put(self, arr, sharding=None, block: bool = False):
         """One counted `device_put`.  `block=True` waits for the transfer
-        (bandwidth probes); otherwise dispatch is async like raw jax."""
+        (bandwidth probes); otherwise dispatch is async like raw jax.
+        Multi-device sharded puts that divide evenly ride the concurrent
+        per-shard engine; everything else takes the coalesced path."""
         import jax
 
         arr = np.asarray(arr)
+        out = self._try_sharded(arr, sharding)
+        if out is not None:
+            if block:
+                jax.block_until_ready(out)
+            return out
         t0 = time.perf_counter()
         out = self._device_put(arr, sharding)
         if block:
@@ -376,9 +565,18 @@ class DeviceFeed:
         byte buffer would multiply wire bytes, so unless the caller opts
         in (`sharded_multi` for replicated consumers), packing engages
         only single-device and the call degrades to per-array puts.
+
+        Items may also be `ops.wire_codec.RLEPayload` (still-encoded
+        chunks): the group then rides the compressed wire — one packed
+        transfer of values + cumulative length tables, re-expanded ON
+        DEVICE (Pallas page-walk kernel on TPU, XLA repeat elsewhere).
         """
         import jax
 
+        from ..ops.wire_codec import RLEPayload
+
+        if arrays and all(isinstance(a, RLEPayload) for a in arrays):
+            return self._put_compressed(list(arrays))
         arrays = [np.ascontiguousarray(a) for a in arrays]
         if shardings is None:
             shardings = [None] * len(arrays)
@@ -415,6 +613,91 @@ class DeviceFeed:
         # the slot is rewritten only after these outputs exist on device
         slot.fence = outs
         return outs
+
+    def _put_compressed(self, payloads):
+        """RLE-encoded chunks over the compressed wire: values + ends
+        tables byte-pack into ONE transfer (the same wire buffer and
+        fault/retry ladder as `put_group`), then each chunk is decoded
+        back to its raw bytes on device (`ops.wire_codec.decode_bytes`)
+        and bitcast/reshaped into shape.  A transfer that exhausts its
+        retries — or an already-degraded feed — decodes on the HOST and
+        rides plain per-chunk puts: the fallback costs wire bytes, never
+        correctness."""
+        from ..ops import wire_codec
+
+        def host_fallback():
+            outs = []
+            for p in payloads:
+                outs.append(self.put(wire_codec.decode_host(p)))
+            return tuple(outs)
+
+        if self.degraded:
+            return host_fallback()
+        wire: List[np.ndarray] = []
+        for p in payloads:
+            wire.append(p.values)
+            wire.append(p.ends)
+        layout = []
+        off = 0
+        for a in wire:
+            layout.append((off, a.shape, a.dtype.str))
+            off += -(-a.nbytes // _ALIGN) * _ALIGN
+        total = max(off, _ALIGN)
+        slot = self._acquire_slot(("bytes", total), (total,), np.uint8)
+        for a, (o, _s, _d) in zip(wire, layout):
+            slot.buf[o:o + a.nbytes] = a.reshape(-1).view(np.uint8)
+        t0 = time.perf_counter()
+        try:
+            packed = self._device_put(slot.buf)
+        except Exception as e:  # noqa: BLE001 — degrade, then the safe path
+            self._degrade(f"compressed wire transfer failed after retries: {e}")
+            return host_fallback()
+        dt = time.perf_counter() - t0
+        raw_bytes = sum(p.nbytes_raw for p in payloads)
+        self.telemetry.add(bytes_moved=total, transfer_calls=1,
+                           transfer_s=dt, chunks_fed=len(payloads),
+                           groups=1, coalesced_chunks=len(payloads),
+                           compressed_groups=1, wire_bytes_raw=raw_bytes,
+                           wire_bytes_sent=total)
+        self._obs_transfer(total, dt, len(payloads))
+        core_telemetry.incr("io.feed.shard.compressed_groups")
+        parts = self._unpack_bytes(packed, tuple(layout), None)
+        use_pallas = wire_codec.rle_kernel_ok()
+        outs = []
+        for i, p in enumerate(payloads):
+            v, e = parts[2 * i], parts[2 * i + 1]
+            raw = wire_codec.decode_bytes(v, e, p.first_run, p.n_pad,
+                                          use_pallas)
+            outs.append(self._finish_decoded(raw, p))
+        outs = tuple(outs)
+        slot.fence = outs
+        return outs
+
+    def _finish_decoded(self, raw, payload):
+        """Decoded uint8[n_pad] -> the chunk's dtype/shape on device; one
+        cached jitted program per (n_pad, nbytes, dtype, shape)."""
+        import jax
+
+        key = ("rle", payload.n_pad, payload.nbytes_raw,
+               payload.dtype.str, payload.shape)
+        fn = self._unpackers.get(key)
+        if fn is None:
+            dt = payload.dtype
+            n = payload.nbytes_raw // dt.itemsize
+            shape = payload.shape
+
+            def finish(buf):
+                seg = buf[:n * dt.itemsize]
+                if dt == np.uint8:
+                    arr = seg
+                else:
+                    arr = jax.lax.bitcast_convert_type(
+                        seg.reshape(n, dt.itemsize), dt)
+                return arr.reshape(shape)
+
+            fn = jax.jit(finish)
+            self._unpackers[key] = fn
+        return fn(raw)
 
     def stream(self, items: Iterable[Tuple[np.ndarray, ...]], shardings=None,
                sharded_multi: bool = False):
@@ -724,7 +1007,11 @@ class H2DStage(Stage):
     host array (or a tuple of arrays packed into one transfer) moved
     through the feed's guarded put path — the `feed.device_put`
     StagePolicy retry ladder and the degrade-to-singletons terminal rung
-    ride underneath unchanged.  The bounded credit budget is the staging
+    ride underneath unchanged.  A meshed feed's stage additionally
+    shards data-divisible batches straight across the mesh (the
+    per-device engine in io/shard_put.py), so the `feed.shard_put`
+    ladder and the sticky shard->coalesced degrade rung are exercised by
+    credit-bounded graphs too.  The bounded credit budget is the staging
     discipline as a declared number: at most `credits` chunks staged
     host-side per graph (lint rule G405 holds every registered Stage
     subclass to one)."""
@@ -741,4 +1028,9 @@ class H2DStage(Stage):
         if isinstance(value, (tuple, list)):
             return self.feed.put_group(
                 tuple(np.asarray(a) for a in value))
-        return self.feed.put(np.asarray(value))
+        arr = np.asarray(value)
+        sharding = None
+        if self.feed.mesh is not None and arr.ndim \
+                and arr.shape[0] % self.feed._dp() == 0:
+            sharding = self.feed._chunk_sharding(arr.ndim)
+        return self.feed.put(arr, sharding)

@@ -47,6 +47,7 @@ __all__ = [
     "MeshContext",
     "batch_sharding",
     "replicated_sharding",
+    "addressable_shard_layout",
     "shard_batch",
     "pad_to_multiple",
 ]
@@ -139,6 +140,25 @@ def batch_sharding(mesh: Mesh, ndim: int = 1, batch_axis: int = 0) -> NamedShard
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def addressable_shard_layout(sharding, shape):
+    """[(device, index)] for every addressable shard of `shape` under
+    `sharding`, in stable device-id order — or None when the shape does
+    not divide evenly (callers fall back to one coalesced transfer).
+
+    This is the substrate of the sharded direct-to-chip path
+    (io/shard_put.py): each (device, index) pair becomes ONE
+    `jax.device_put(arr[index], device)` riding its own transfer stream,
+    and the shards reassemble zero-copy with
+    `jax.make_array_from_single_device_arrays`."""
+    try:
+        imap = sharding.addressable_devices_indices_map(tuple(shape))
+    except (ValueError, TypeError):
+        return None
+    if not imap or any(idx is None for idx in imap.values()):
+        return None
+    return sorted(imap.items(), key=lambda di: di[0].id)
 
 
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0) -> Tuple[np.ndarray, int]:

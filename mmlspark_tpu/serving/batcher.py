@@ -118,7 +118,8 @@ class ContinuousBatcher:
                  kv_cache_dtype: str = None,
                  paged: bool = False, page_size: int = 64,
                  num_pages: Optional[int] = None,
-                 draft_model=None, draft_variables=None, gamma: int = 4):
+                 draft_model=None, draft_variables=None, gamma: int = 4,
+                 feed=None):
         if kv_cache_dtype not in (None, "int8"):
             raise ValueError(f"kv_cache_dtype must be None or 'int8', "
                              f"got {kv_cache_dtype!r}")
@@ -148,8 +149,12 @@ class ContinuousBatcher:
         # admission prefill batches) rides the shared feed engine: the
         # tick's 2-3 small arrays byte-pack into ONE device_put — through a
         # high-latency link each separate transfer is a full round trip on
-        # the decode tick's critical path
-        self._feed = DeviceFeed()
+        # the decode tick's critical path.  Callers may inject a
+        # configured feed (`feed=`) — e.g. one carrying the autotuner's
+        # winner (io.feed.load_tuned) or a meshed sharded engine — and
+        # the prefill uploads inherit it; the default feed still adopts
+        # MMLSPARK_FEED_TUNED on its own
+        self._feed = feed if feed is not None else DeviceFeed()
         self.max_slots = int(max_slots)
         self.idle_sleep_s = float(idle_sleep_s)
         # bounded intake: submit() sheds (raises Overloaded) once this many

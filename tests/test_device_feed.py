@@ -241,3 +241,86 @@ def test_process_telemetry_sink_is_shared():
     DeviceFeed().put(np.zeros((2, 2), np.uint8))
     d = FEED_TELEMETRY.delta(before)
     assert d["transfer_calls"] == 1 and d["bytes_moved"] == 4
+
+
+# ---- the autotuner config and strategy resolution --------------------------
+
+def _clear_tuned_cache():
+    from mmlspark_tpu.io import feed as feed_mod
+
+    with feed_mod._TUNED_LOCK:
+        feed_mod._TUNED_CACHE.clear()
+
+
+def test_tuned_config_adopted_by_default_knobs(tmp_path, monkeypatch):
+    """A feed_tune winner pointed at by MMLSPARK_FEED_TUNED fills every
+    knob the caller left at None; explicit arguments still win."""
+    import json
+
+    from mmlspark_tpu.io.feed import load_tuned
+
+    cfg = tmp_path / "tuned.json"
+    cfg.write_text(json.dumps({"depth": 3, "coalesce": 6,
+                               "strategy": "coalesced"}))
+    monkeypatch.setenv("MMLSPARK_FEED_TUNED", str(cfg))
+    monkeypatch.delenv("MMLSPARK_FEED_DEPTH", raising=False)
+    _clear_tuned_cache()
+    assert load_tuned()["depth"] == 3
+    feed = DeviceFeed()
+    assert feed.depth == 3 and feed.coalesce == 6
+    assert feed.shard_strategy == "coalesced"
+    explicit = DeviceFeed(depth=1, coalesce=2, shard_strategy="auto")
+    assert explicit.depth == 1 and explicit.coalesce == 2
+    assert explicit.shard_strategy == "auto"
+    _clear_tuned_cache()
+
+
+def test_tuned_config_corrupt_file_is_empty_not_fatal(tmp_path,
+                                                      monkeypatch):
+    """A torn/corrupt tuned file must un-tune, never crash: tuning is
+    an optimization, not a dependency."""
+    from mmlspark_tpu.io.feed import load_tuned
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv("MMLSPARK_FEED_TUNED", str(bad))
+    _clear_tuned_cache()
+    assert load_tuned() == {}
+    feed = DeviceFeed()  # defaults, no exception
+    assert feed.depth >= 1
+    _clear_tuned_cache()
+
+
+def test_shard_strategy_env_beats_tuned(tmp_path, monkeypatch):
+    import json
+
+    cfg = tmp_path / "tuned.json"
+    cfg.write_text(json.dumps({"strategy": "sharded"}))
+    monkeypatch.setenv("MMLSPARK_FEED_TUNED", str(cfg))
+    monkeypatch.setenv("MMLSPARK_FEED_SHARD", "coalesced")
+    _clear_tuned_cache()
+    assert DeviceFeed().shard_strategy == "coalesced"
+    _clear_tuned_cache()
+
+
+def test_shard_strategy_rejects_unknown():
+    with pytest.raises(ValueError, match="shard_strategy"):
+        DeviceFeed(shard_strategy="turbo")
+
+
+def test_feed_tune_sweep_writes_winner(tmp_path):
+    """The autotuner end to end on a tiny sweep: a winner JSON lands
+    atomically and carries the keys DeviceFeed consults."""
+    import json
+
+    from tools.feed_tune import main as tune_main
+
+    out = tmp_path / "FEED_TUNED.json"
+    rc = tune_main(["--images", "8", "--side", "16", "--chunk-sizes",
+                    "4", "--depths", "1", "--strategies", "coalesced",
+                    "--trials", "1", "--out", str(out)])
+    assert rc == 0
+    winner = json.loads(out.read_text())
+    assert winner["strategy"] == "coalesced"
+    assert winner["depth"] == 1 and winner["chunk"] == 4
+    assert {"coalesce", "platform", "devices"} <= set(winner)

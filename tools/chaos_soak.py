@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -271,8 +272,19 @@ def run_soak(seed: int = 7, n_requests: int = 48, max_queue: int = 8,
 def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
                   n_expired: int = 4, n_tight: int = 4) -> dict:
     """Soak the graftflow runtime (core/flow.py) under seeded faults at
-    every registered `flow.*` point; returns a JSON-able summary dict,
+    every registered `flow.*` point PLUS the feed's transfer points
+    (`io.feed.FEED_FAULT_POINTS`); returns a JSON-able summary dict,
     raises AssertionError on any violated invariant.
+
+    The arming loop enumerates both registries, so a newly added flow
+    stage or feed fault point is covered automatically — unscripted
+    points get a harmless fire-once rule, and the exact fire-count
+    reconciliation (`faults.injected == sum(fires)`) cannot go stale.
+    After the flow-graph ledger, an h2d leg drives a meshed DeviceFeed
+    through an `H2DStage` graph with every sharded attempt failing: the
+    per-shard retry ladder must exhaust, degrade stickily to the
+    coalesced rung, and still deliver every array byte-identical with a
+    transient `feed.device_put` fault absorbed on the way.
 
     The ledger it proves:
 
@@ -289,10 +301,15 @@ def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
     Runs under a `VirtualClock`: injected latency and retry backoffs
     advance virtual time only, so deadline lapses are scripted and the
     soak resolves in milliseconds of wall time."""
+    import jax
+    import numpy as np
+
     from mmlspark_tpu.core import telemetry
     from mmlspark_tpu.core.flow import (AdmissionStage, Expired, FlowGraph,
                                         FlowItem, Stage, StagePolicy,
                                         flow_fault_points)
+    from mmlspark_tpu.io.feed import (FEED_FAULT_POINTS, DeviceFeed,
+                                      FeedTelemetry)
     from mmlspark_tpu.utils.fault_tolerance import Overloaded
     from mmlspark_tpu.utils.faults import (FAULTS, FaultPlan, InjectedFault,
                                            VirtualClock, monotonic,
@@ -311,26 +328,50 @@ def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
              Stage(name="emit", fn=lambda t: t,
                    workers=1, credits=4, policy=policy)],
             queue_size=8, span_prefix="flow")
-        # arm EVERY registered flow.* point; each error rule fires at
-        # most retries-1 times so no single item can exhaust its
-        # StagePolicy ladder whatever the thread interleaving.  The
-        # decode rule is latency-only: one injected 1s stall (virtual)
-        # lapses the medium deadlines mid-graph — the shed must then
-        # happen at the NEXT boundary, never silently drop the slot.
+        # the h2d leg: a meshed feed behind an H2DStage graph, built
+        # BEFORE the plan so its `flow.h2d` point is registered and
+        # armed like every other stage
+        multi = len(jax.devices()) > 1
+        mesh = None
+        if multi:
+            from mmlspark_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh()
+        feed = DeviceFeed(mesh=mesh, telemetry=FeedTelemetry(),
+                          transfer_retries=3,
+                          shard_strategy="sharded" if multi
+                          else "coalesced")
+        h2d_graph = FlowGraph([feed.stage()], queue_size=8,
+                              span_prefix="flow")
+        # arm EVERY registered flow.* point plus the feed's transfer
+        # points; each flow error rule fires at most retries-1 times so
+        # no single item can exhaust its StagePolicy ladder whatever the
+        # thread interleaving.  The decode rule is latency-only: one
+        # injected 1s stall (virtual) lapses the medium deadlines
+        # mid-graph — the shed must then happen at the NEXT boundary,
+        # never silently drop the slot.  The shard rule is the opposite
+        # by design: EVERY sharded attempt fails, so the per-shard
+        # ladder exhausts and the feed must take its sticky
+        # shard->coalesced degrade rung (then absorb one transient
+        # coalesced-put fault via the transfer retry ladder).
         config = {
             "flow.admission": dict(nth=[2, 19]),
             "flow.decode": dict(nth=[1], latency_s=1.0, error=None),
             "flow.assemble": dict(nth=[2, 11]),
             "flow.emit": dict(nth=[3, 12]),
+            "feed.shard_put": dict(probability=1.0),
+            "feed.device_put": dict(nth=[1]),
         }
+        armable = tuple(flow_fault_points()) + tuple(
+            p for p in FEED_FAULT_POINTS if p not in flow_fault_points())
         plan = FaultPlan(seed=seed)
-        for p in flow_fault_points():
+        for p in armable:
             # points registered by other graphs in this process get a
             # harmless latency-0 rule: armed, never consequential
             plan.on(p, **config.get(p, dict(nth=[0], latency_s=0.0,
                                             error=None)))
-        missing = [p for p in config if p not in flow_fault_points()]
-        assert not missing, f"expected flow points unregistered: {missing}"
+        missing = [p for p in config if p not in armable]
+        assert not missing, f"expected fault points unregistered: {missing}"
 
         outcomes: dict = {}  # item id -> "accepted" | "shed"
 
@@ -385,6 +426,13 @@ def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
             out = list(graph.run(
                 (FlowItem(val, dl) for val, dl in fed),
                 yield_expired=True))
+            # ---- the h2d leg: same plan, the feed's fault points ------
+            n_h2d = 6
+            dp = len(jax.devices()) if multi else 1
+            h2d_in = [np.full((4 * dp, 3), float(i), np.float32)
+                      for i in range(n_h2d)]
+            h2d_out = [np.asarray(y)
+                       for y in h2d_graph.run(list(h2d_in))]
         fires = dict(FAULTS.fires)
 
     # ---- the ledger ------------------------------------------------------
@@ -425,6 +473,31 @@ def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
     assert fires.get("flow.assemble", 0) == 2
     assert fires.get("flow.emit", 0) == 2
 
+    # ---- the h2d leg's ledger --------------------------------------------
+    # every array delivered exactly once, in order, byte-identical —
+    # through the exhausted shard ladder, the sticky degrade, and the
+    # retried coalesced-put fault
+    assert len(h2d_out) == n_h2d, \
+        f"h2d graph emitted {len(h2d_out)} arrays for {n_h2d} items"
+    for want, got in zip(h2d_in, h2d_out):
+        np.testing.assert_array_equal(got, want)
+    # the harmless fire-once rule on flow.h2d proves the stage's point
+    # is armed; the transient feed.device_put fault was absorbed by the
+    # transfer retry ladder (fired exactly once, nothing degraded)
+    assert fires.get("flow.h2d", 0) == 1
+    assert fires.get("feed.device_put", 0) == 1
+    assert not feed.degraded, "a retried transient put degraded the feed"
+    if multi:
+        # the shard script: dp shards x transfer_retries attempts, every
+        # one failed -> ShardTransferError -> sticky shard degrade; no
+        # later put re-enters the shard engine
+        assert feed.shard_degraded, "shard faults never degraded the feed"
+        assert fires.get("feed.shard_put", 0) == 3 * dp, \
+            (f"feed.shard_put fired {fires.get('feed.shard_put')} times, "
+             f"want {3 * dp} (every attempt of every shard)")
+    else:
+        assert fires.get("feed.shard_put", 0) == 0
+
     # ---- registry snapshot reconciliation --------------------------------
     snapshot = telemetry.export_snapshot()
     c = snapshot["counters"]
@@ -444,6 +517,8 @@ def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
                             and k != "flow.expired.admission")
     assert per_stage_expired == len(markers), \
         "per-stage flow.expired.* rows do not sum to the marker count"
+    assert c.get("feed.shard_degraded", 0) == (1 if multi else 0), \
+        "feed.shard_degraded counter disagrees with the observed degrade"
 
     return {
         "seed": seed,
@@ -456,6 +531,10 @@ def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
         "delivered": len(fed) - len(markers),
         "lost": 0,
         "duplicated": 0,
+        "h2d_delivered": len(h2d_out),
+        "h2d_devices": dp,
+        "h2d_shard_degraded": bool(feed.shard_degraded),
+        "armed_points": list(armable),
         "faults_fired": fires,
         "high_water": hw,
         "counters": c,
@@ -509,6 +588,14 @@ def main(argv=None):
                     help="write the full observability snapshot (spans "
                          "included) to PATH for tools/obs_report.py")
     args = ap.parse_args(argv)
+    if args.flow and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the h2d leg's shard ladder needs a multi-device mesh; on a
+        # bare CPU host force the 8-device virtual platform before jax
+        # initializes (inert on real multi-chip backends)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8"
+                                   ).strip()
     import tools.graftsan as graftsan
 
     # sanitized by default: the soak is exactly the concurrency load the

@@ -11,6 +11,7 @@ anywhere:
                                             # bench regression gate
     python tools/ci.py fleet-smoke          # gateway kill/revive soak
     python tools/ci.py flow-soak            # graftflow runtime chaos soak
+    python tools/ci.py feed-bench           # 3-path h2d transfer smoke
     python tools/ci.py sanitize [--json]    # all soaks under GRAFTSAN=1
                                             # (tools/graftsan runtime
                                             # concurrency sanitizer)
@@ -295,6 +296,28 @@ def train_smoke(timeout_s: int = 300) -> int:
     return rc
 
 
+def feed_bench_smoke(timeout_s: int = 300) -> int:
+    """Run tools/feed_bench.py across all three transfer paths on a
+    small workload as a smoke job: the sharded, coalesced, and
+    compressed paths must all produce parity results (feed_bench
+    asserts byte equality against the naive baseline) on the virtual
+    8-device CPU mesh any CI machine can host."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=8")
+               .strip())
+    cmd = [sys.executable, os.path.join("tools", "feed_bench.py"),
+           "--images", "64", "--chunks", "4", "--side", "64",
+           "--sharded", "--coalesced", "--compressed"]
+    try:
+        rc = subprocess.call(cmd, cwd=ROOT, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"feed-bench timed out after {timeout_s}s")
+        return 1
+    print("feed-bench:", "OK" if rc == 0 else f"FAILED (rc={rc})")
+    return rc
+
+
 def flow_soak(timeout_s: int = 300) -> int:
     """Run the graftflow runtime soak (tools/chaos_soak.py --flow) as a
     smoke job: seeded faults at every registered flow.* point, bounded-
@@ -351,7 +374,7 @@ def main(argv=None):
     ap.add_argument("command", choices=["lint", "metrics-lint", "test",
                                         "perf-gate", "fleet-smoke",
                                         "train-soak", "flow-soak",
-                                        "sanitize", "all"])
+                                        "feed-bench", "sanitize", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
@@ -383,6 +406,8 @@ def main(argv=None):
         return train_smoke()
     if args.command == "flow-soak":
         return flow_soak()
+    if args.command == "feed-bench":
+        return feed_bench_smoke()
     if args.command == "sanitize":
         return sanitize(json_out=args.json)
     if args.command == "test":

@@ -45,8 +45,14 @@ GATE_METRICS: Dict[str, Tuple[str, float, float]] = {
     "lm_tokens_per_sec": ("higher", 0.10, 0.0),
     "lm_train_mfu": ("higher", 0.10, 0.0),
     "decode_ips": ("higher", 0.20, 0.0),
+    # h2d_gbps direction=up is the ISSUE-14 lock-in: a regression back to
+    # the pre-sharded slow path fails the gate, not just the dashboard
     "h2d_gbps": ("higher", 0.25, 0.0),
     "h2d_ips": ("higher", 0.25, 0.0),
+    # what fraction of the jitted forward's throughput e2e delivers; the
+    # h2d wall shows up here first (absolute floor: base hovers near 0
+    # on h2d-bound links, so a pure relative band would be dust-sized)
+    "e2e_over_forward_frac": ("higher", 0.20, 0.02),
     "feed_gbps": ("higher", 0.25, 0.0),
     "overlap_frac": ("higher", 0.20, 0.05),
     "stall_s": ("lower", 0.50, 0.05),
