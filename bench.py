@@ -369,6 +369,58 @@ def _measure_sanitizer(n_items: int = 400, reps: int = 5) -> dict:
     }
 
 
+def _measure_fleet_scrape(n_replicas: int = 8, reps: int = 5,
+                          warm_requests: int = 16) -> dict:
+    """Wall cost of one federated telemetry pull over an 8-replica pool
+    (PR 15 fleet plane): `FleetTelemetry.pull_once()` GETs every
+    replica's /metrics.json, merges counters/gauges/histograms exactly,
+    and runs the SLO engine — all WITHOUT the gateway routing lock, so
+    the scrape cost may grow with fleet size but must never stall
+    forwarding.  perf_gate bands `fleet_scrape_ms` (best-of-reps)."""
+    import numpy as np
+
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+    from mmlspark_tpu.io.http.clients import send_request
+    from mmlspark_tpu.io.http.schema import to_http_request
+    from mmlspark_tpu.serving import FleetGateway, ServingServer
+
+    def make_replica():
+        def fn(table):
+            v = np.asarray(table["v"], np.int64)
+            return table.with_column("y", v * 3)
+
+        return ServingServer(LambdaTransformer(fn), reply_col="y",
+                             name="scrape-bench", input_schema=["v"],
+                             max_batch=8, batch_timeout_ms=5.0)
+
+    replicas = [make_replica() for _ in range(n_replicas)]
+    gw = FleetGateway(name="scrape-bench", probe_interval_s=5.0)
+    try:
+        for r in replicas:
+            r.start()
+            gw.add_server(r, version="v1")
+        gw.start()
+        # populate every registry view so the merge does real work
+        for i in range(warm_requests):
+            send_request(to_http_request(gw.url, {"v": i}), timeout=10.0)
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            merged = gw.telemetry_plane.pull_once()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        assert merged["meta"]["replica_count"] == n_replicas + 1  # +gateway
+        return {"fleet_scrape_ms": round(best * 1e3, 3),
+                "fleet_scrape_replicas": n_replicas}
+    finally:
+        gw.stop()
+        for r in replicas:
+            try:
+                r.stop(drain=False)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+
 def _measure_transformer(batch: int = 16, seq: int = 1024,
                          steps: int = 8,
                          force_xla_attn: bool = False) -> dict:
@@ -727,6 +779,10 @@ def _child_measure():
         san = _measure_sanitizer()
     except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
         san = {"sanitizer_error": str(e)[-200:]}
+    try:
+        fleet = _measure_fleet_scrape()
+    except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
+        fleet = {"fleet_scrape_error": str(e)[-200:]}
     # the registry's own view of the run rides along so --obs-out saves
     # a self-describing snapshot (meta: backend/devices/pid/timestamp)
     from mmlspark_tpu.core import telemetry as core_telemetry
@@ -735,7 +791,8 @@ def _child_measure():
         include_spans=False,
         timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm,
-                      "guard": guard, "san": san, "obs": obs}))
+                      "guard": guard, "san": san, "fleet": fleet,
+                      "obs": obs}))
 
 
 def _obs_out_path():
@@ -877,6 +934,8 @@ def main():
         **{k: v for k, v in child.get("guard", {}).items()
            if v is not None},
         **{k: v for k, v in child.get("san", {}).items()
+           if v is not None},
+        **{k: v for k, v in child.get("fleet", {}).items()
            if v is not None},
         "device_kind": res["device_kind"],
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

@@ -36,12 +36,16 @@ from typing import Dict, Optional, Sequence
 
 from ...utils.stopwatch import StopWatch
 from .metrics import (
+    BUCKET_FAMILIES,
     BYTE_BUCKETS,
     DECLARED_METRICS,
+    FILL_BUCKETS,
     Gauge,
+    HISTOGRAM_FAMILY,
     Histogram,
     MetricsRegistry,
     REGISTRY,
+    buckets_for,
     default_buckets,
     is_declared,
 )
@@ -66,6 +70,16 @@ from .exposition import (
     render_chrome_trace,
     render_prometheus,
 )
+from .fleet import (
+    FlightRecorder,
+    SLO,
+    SLOEngine,
+    default_slos,
+    merge_snapshots,
+    merge_histogram_snapshots,
+    render_fleet_prometheus,
+    stitch_spans,
+)
 from .device import (
     SENTRY,
     CompileSentry,
@@ -87,8 +101,9 @@ __all__ = [
     "StopWatch",
     # metrics
     "REGISTRY", "MetricsRegistry", "Gauge", "Histogram", "gauge",
-    "histogram", "default_buckets", "BYTE_BUCKETS", "DECLARED_METRICS",
-    "is_declared",
+    "histogram", "default_buckets", "BYTE_BUCKETS", "FILL_BUCKETS",
+    "BUCKET_FAMILIES", "HISTOGRAM_FAMILY", "buckets_for",
+    "DECLARED_METRICS", "is_declared",
     # spans
     "span", "record_span", "use_trace", "current_context",
     "current_trace_id", "trace_headers", "extract_trace", "get_trace",
@@ -96,6 +111,10 @@ __all__ = [
     # exposition
     "render_prometheus", "export_snapshot", "render_chrome_trace",
     "format_span_tree", "format_latency_table",
+    # fleet federation (merge / stitch / SLO / incidents)
+    "merge_snapshots", "merge_histogram_snapshots",
+    "render_fleet_prometheus", "stitch_spans", "SLO", "SLOEngine",
+    "default_slos", "FlightRecorder",
     # device (compile sentry, memory gauges, annotations)
     "SENTRY", "CompileSentry", "track_compiles", "watch_compiles",
     "sample_device_memory", "MemorySampler", "start_memory_sampler",

@@ -29,7 +29,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["DECLARED_METRICS", "is_declared", "Gauge", "Histogram",
            "MetricsRegistry", "REGISTRY", "default_buckets",
-           "BYTE_BUCKETS"]
+           "BYTE_BUCKETS", "FILL_BUCKETS", "BUCKET_FAMILIES",
+           "HISTOGRAM_FAMILY", "buckets_for"]
 
 # ---------------------------------------------------------------------------
 # The declared-name table: every static metric/counter name in the tree.
@@ -87,6 +88,15 @@ DECLARED_METRICS: Dict[str, str] = {
     "serving.fleet.deadline_expired": "counter",
     "serving.fleet.rollback": "counter",
     "serving.fleet.promote": "counter",
+    # -- counters: federated telemetry plane (core/telemetry/fleet.py, PR 15)
+    "fleet.pull": "counter",              # one per completed federated pull
+    "fleet.pull_failed": "counter",       # + .<replica> variants
+    "fleet.incident": "counter",          # flight-recorder bundles written
+    "slo.alert.pending": "counter",       # + .<slo> variants
+    "slo.alert.firing": "counter",        # + .<slo> variants
+    "slo.alert.resolved": "counter",      # + .<slo> variants
+    "autoscale.up": "counter",
+    "autoscale.down": "counter",
     # -- histograms
     "serving.request.latency": "histogram",
     "serving.batch.fill": "histogram",
@@ -103,6 +113,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "xla.compile.latency": "histogram",
     "serving.fleet.request.latency": "histogram",   # gateway e2e, labeled
     "serving.fleet.replica.latency": "histogram",   # labeled {replica=...}
+    "fleet.scrape.latency": "histogram",    # one full federated pull+merge
     # -- gauges
     "serving.queue.depth": "gauge",
     "serving.batcher.queue_depth": "gauge",
@@ -126,6 +137,9 @@ DECLARED_METRICS: Dict[str, str] = {
     "device.live_buffer_count": "gauge",
     "serving.fleet.replicas": "gauge",
     "serving.fleet.healthy": "gauge",
+    "fleet.pull.replicas": "gauge",       # replicas reached by last pull
+    "slo.burn_rate": "gauge",             # + .<slo> variants
+    "autoscale.target_replicas": "gauge",
 }
 
 
@@ -146,6 +160,57 @@ def default_buckets() -> Tuple[float, ...]:
 
 # power-of-4 spacing, 64 B .. 1 GiB: the transfer-size ladder
 BYTE_BUCKETS: Tuple[float, ...] = tuple(float(64 * 4 ** i) for i in range(13))
+
+# linear 0.05 .. 1.0: the fill-fraction ladder (batch occupancy is a
+# ratio, not a latency — a log ladder wastes 15 of 19 edges above 1.0)
+FILL_BUCKETS: Tuple[float, ...] = tuple(i / 20.0 for i in range(1, 21))
+
+# ---------------------------------------------------------------------------
+# Named bucket families.  Every DECLARED histogram must resolve to one of
+# these ladders (graftlint M003): fleet-level federation merges replica
+# histograms bucket-by-bucket, which is only exact when every replica —
+# and every process version in a mixed rollout — shares identical `le`
+# edges.  Pinning the ladder at declaration makes edge drift a lint
+# error instead of a silently-wrong merged p99.
+# ---------------------------------------------------------------------------
+BUCKET_FAMILIES: Dict[str, Tuple[float, ...]] = {
+    "latency": tuple(10.0 ** (-6 + i / 2.0) for i in range(19)),
+    "bytes": BYTE_BUCKETS,
+    "fill": FILL_BUCKETS,
+}
+
+# declared histogram name -> family key in BUCKET_FAMILIES
+HISTOGRAM_FAMILY: Dict[str, str] = {
+    "serving.request.latency": "latency",
+    "serving.batch.fill": "fill",
+    "serving.batcher.batch_fill": "fill",
+    "io.feed.transfer.latency": "latency",
+    "io.feed.transfer.bytes": "bytes",
+    "io.feed.shard.latency": "latency",
+    "io.feed.shard.bytes": "bytes",
+    "io.pipeline.stage.latency": "latency",
+    "flow.stage.latency": "latency",
+    "io.http.request.latency": "latency",
+    "models.training.step_latency": "latency",
+    "checkpoint.verify.latency": "latency",
+    "xla.compile.latency": "latency",
+    "serving.fleet.request.latency": "latency",
+    "serving.fleet.replica.latency": "latency",
+    "fleet.scrape.latency": "latency",
+}
+
+
+def buckets_for(name: str) -> Optional[Tuple[float, ...]]:
+    """The family ladder for a declared histogram name (exact or
+    per-entity child), or None when the name carries no family."""
+    fam = HISTOGRAM_FAMILY.get(name)
+    if fam is None:
+        for decl, f in HISTOGRAM_FAMILY.items():
+            if name.startswith(decl + "."):
+                fam = f
+                break
+    return BUCKET_FAMILIES[fam] if fam is not None else None
+
 
 _STRIPES = 8
 
@@ -328,8 +393,21 @@ class MetricsRegistry:
             if h is None:
                 bs = self._hist_buckets.get(name)
                 if bs is None:
-                    bs = (tuple(boundaries) if boundaries is not None
-                          else default_buckets())
+                    fam = buckets_for(name)
+                    if fam is not None:
+                        # declared family names are pinned to their
+                        # ladder: an explicit disagreeing `boundaries`
+                        # would make fleet merges inexact (M003)
+                        if (boundaries is not None
+                                and tuple(boundaries) != fam):
+                            raise ValueError(
+                                f"histogram {name!r} is declared with a "
+                                f"bucket family; explicit boundaries "
+                                f"must match it")
+                        bs = fam
+                    else:
+                        bs = (tuple(boundaries) if boundaries is not None
+                              else default_buckets())
                     self._hist_buckets[name] = bs
                 h = self._hists[key] = Histogram(name, bs)
             return h

@@ -7,9 +7,15 @@ accept, batch, jitted transform, reply over the held socket.
 Fleet layer (PR 9, docs/serving.md): FleetGateway routes across replica
 pools (p2c balancing, deadline decrement, retry, breaker ejection +
 probe reinstatement); RolloutController drives metrics-gated canaries.
+
+Telemetry plane (PR 15, docs/observability.md): FleetTelemetry federates
+every replica's metrics/spans behind ``/fleet/metrics`` and a stitched
+``/trace/<id>``, feeds SLO burn-rate alerts, and AutoscaleController
+drives replica counts from the merged signals.
 """
+from .autoscale import AutoscaleController, CapacityModel
 from .dsl import DistributedServingServer, StreamingQuery, StreamReader, read_stream
-from .fleet import FleetGateway, Replica
+from .fleet import FleetGateway, FleetTelemetry, Replica
 from .journal import EpochJournal
 from .registry import (
     ServiceRegistry,
@@ -17,7 +23,7 @@ from .registry import (
     list_services,
     register_service,
 )
-from .rollout import ROLLOUT_METRICS, RolloutController
+from .rollout import ROLLOUT_METRICS, RolloutController, drain_and_stop
 from .server import (
     CachedRequest,
     ServiceInfo,
@@ -44,7 +50,11 @@ __all__ = [
     "StreamingQuery",
     "DistributedServingServer",
     "FleetGateway",
+    "FleetTelemetry",
     "Replica",
     "RolloutController",
     "ROLLOUT_METRICS",
+    "drain_and_stop",
+    "AutoscaleController",
+    "CapacityModel",
 ]

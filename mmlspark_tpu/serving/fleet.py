@@ -48,6 +48,20 @@ more traffic).  A background prober GETs every replica's ``/health`` on
 an interval (fault point ``fleet.health``); a live answer closes the
 circuit and reinstates the replica, so a revived process at the same
 address rejoins the pool without operator action.
+
+Fleet telemetry plane (PR 15)
+-----------------------------
+:class:`FleetTelemetry` is the HTTP half of `core/telemetry/fleet.py`:
+it pulls every replica's ``/metrics.json`` snapshot (never holding the
+gateway routing lock across the wire), merges them exactly, feeds the
+SLO burn-rate engine, and exposes ``GET /fleet/metrics`` (Prometheus +
+JSON), ``GET /fleet/alerts``, and a federated ``GET /trace/<id>`` that
+stitches one client trace across gateway + replica span stores.  A pull
+failure marks the replica unhealthy through the same probe/breaker path
+as an active health-probe failure — closing the registry-TTL gap where
+a replica that died between registry syncs stayed routable until the
+next scrape.  On an alert transitioning to firing, the attached
+FlightRecorder dumps an incident bundle under ``incidents/<ts>/``.
 """
 from __future__ import annotations
 
@@ -67,7 +81,7 @@ from .registry import list_services
 from ..utils.sync import make_lock
 from .server import ServiceInfo
 
-__all__ = ["Replica", "FleetGateway"]
+__all__ = ["Replica", "FleetGateway", "FleetTelemetry"]
 
 # hop-by-hop (and gateway-owned) headers never copied onto the forward
 _HOP_HEADERS = frozenset({
@@ -152,7 +166,10 @@ class FleetGateway:
                  breaker_threshold: int = 2,
                  breaker_reset_s: float = 0.5,
                  forward_timeout_s: float = 30.0,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 telemetry_interval_s: Optional[float] = None,
+                 incident_dir: Optional[str] = None,
+                 slos=None):
         self.name = name
         self.path = path if path.startswith("/") else "/" + path
         self.registry_url = registry_url
@@ -170,6 +187,13 @@ class FleetGateway:
         # per-version rolling stats feeding the rollout gate
         self._vstats: Dict[str, dict] = {}
         self.rollout = None  # RolloutController attaches itself here
+        self.autoscale = None  # AutoscaleController attaches itself here
+        # the federated telemetry plane: always constructed (the
+        # /fleet/* endpoints pull synchronously when stale), background
+        # puller thread only when an interval is configured
+        self.telemetry_plane = FleetTelemetry(
+            self, pull_interval_s=telemetry_interval_s,
+            incident_dir=incident_dir, slos=slos)
         self._running = threading.Event()
         self._stop_evt = threading.Event()  # wakes the prober on stop()
         outer = self
@@ -214,6 +238,36 @@ class FleetGateway:
                     self._reply(200, payload,
                                 {"Content-Type": "application/json"})
                     return
+                if path == "/fleet/metrics":
+                    merged = outer.telemetry_plane.ensure_fresh()
+                    payload = telemetry.render_fleet_prometheus(
+                        merged).encode("utf-8")
+                    self._reply(200, payload,
+                                {"Content-Type":
+                                 "text/plain; version=0.0.4; charset=utf-8"})
+                    return
+                if path == "/fleet/metrics.json":
+                    merged = outer.telemetry_plane.ensure_fresh()
+                    payload = json.dumps(merged, default=repr).encode(
+                        "utf-8")
+                    self._reply(200, payload,
+                                {"Content-Type": "application/json"})
+                    return
+                if path == "/fleet/alerts":
+                    outer.telemetry_plane.ensure_fresh()
+                    payload = json.dumps({
+                        "alerts": outer.telemetry_plane.engine.alerts(),
+                    }, default=repr).encode("utf-8")
+                    self._reply(200, payload,
+                                {"Content-Type": "application/json"})
+                    return
+                if path == "/metrics.json":
+                    payload = json.dumps(
+                        telemetry.export_snapshot(include_spans=False),
+                        default=repr).encode("utf-8")
+                    self._reply(200, payload,
+                                {"Content-Type": "application/json"})
+                    return
                 if path == "/health":
                     self._reply(200, b'{"status": "ok"}',
                                 {"Content-Type": "application/json"})
@@ -231,16 +285,16 @@ class FleetGateway:
                                 {"Content-Type": "application/json"})
                     return
                 if path.startswith("/trace/"):
+                    # federated: fan out to every replica's span store
+                    # and stitch the hops under the client's trace id
                     tid = path[len("/trace/"):].strip("/")
-                    spans = telemetry.get_trace(tid)
-                    if not spans:
+                    stitched = outer.telemetry_plane.fetch_trace(tid)
+                    if not stitched["spans"]:
                         self._reply(404, b'{"error": "unknown trace id"}',
                                     {"Content-Type": "application/json"})
                         return
-                    payload = json.dumps({
-                        "trace_id": tid, "spans": spans,
-                        "tree": telemetry.span_tree(tid),
-                    }).encode("utf-8")
+                    payload = json.dumps(stitched, default=repr).encode(
+                        "utf-8")
                     self._reply(200, payload,
                                 {"Content-Type": "application/json"})
                     return
@@ -371,11 +425,13 @@ class FleetGateway:
             self.sync_registry()
         self._thread.start()
         self._prober.start()
+        self.telemetry_plane.start()
         return self.service_info
 
     def stop(self):
         self._running.clear()
         self._stop_evt.set()
+        self.telemetry_plane.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         self._prober.join(timeout=5)
@@ -424,6 +480,9 @@ class FleetGateway:
         }
         if self.rollout is not None:
             out["rollout"] = self.rollout.describe()
+        if self.autoscale is not None:
+            out["autoscale"] = self.autoscale.describe()
+        out["telemetry"] = self.telemetry_plane.describe()
         return out
 
     # ---- routing -------------------------------------------------------
@@ -729,3 +788,204 @@ class FleetGateway:
         elif not ok and was_routable:
             telemetry.incr("serving.fleet.eject")
         self._update_gauges()
+
+
+class FleetTelemetry:
+    """The gateway-side federated telemetry plane (HTTP half of
+    `core/telemetry/fleet.py`).
+
+    ``pull_once()`` copies the replica list (one brief gateway-lock
+    acquisition), then performs every ``/metrics.json`` GET and the
+    merge WITHOUT the routing lock — a slow replica scrape can never
+    stall request routing.  The gateway's own registry rides along as
+    source ``gateway``, so fleet-level gauges (``serving.fleet.healthy``
+    / ``.replicas``) and the merged request histograms land in one view
+    the :class:`~mmlspark_tpu.core.telemetry.fleet.SLOEngine` evaluates.
+
+    A pull failure is a health signal, not just a gap in the data: the
+    replica is marked unhealthy immediately through the same
+    ``_mark_probe`` path as an active probe failure (eject counter,
+    gauges, breaker reinstatement later) — this closes the
+    registry-TTL-on-read hole where a replica that died between
+    registry syncs stayed routable until something else noticed.
+    """
+
+    def __init__(self, gateway: "FleetGateway",
+                 pull_interval_s: Optional[float] = None,
+                 pull_timeout_s: float = 2.0,
+                 slos=None,
+                 incident_dir: Optional[str] = None,
+                 clock=None,
+                 worst_traces: int = 3):
+        self.gateway = gateway
+        self.pull_interval_s = pull_interval_s
+        self.pull_timeout_s = float(pull_timeout_s)
+        self.worst_traces = int(worst_traces)
+        kwargs = {} if clock is None else {"clock": clock}
+        self.engine = telemetry.SLOEngine(
+            slos if slos is not None else telemetry.default_slos(),
+            **kwargs)
+        self.recorder = (telemetry.FlightRecorder(incident_dir)
+                         if incident_dir else None)
+        if self.recorder is not None:
+            self.engine.on_transition(self._on_transition)
+        self._lock = make_lock("serving.fleet.telemetry")
+        self._merged: Optional[dict] = None  #: guarded-by self._lock
+        self._last_pull = 0.0  #: guarded-by self._lock (0 = never pulled)
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- pulling -------------------------------------------------------
+
+    def _get_json(self, host: str, port: int, path: str) -> Optional[dict]:
+        try:
+            fault_point("fleet.pull")
+            resp = send_request(HTTPRequestData(
+                url=f"http://{host}:{port}{path}", method="GET"),
+                timeout=self.pull_timeout_s)
+            if not resp.ok:
+                return None
+            return resp.json()
+        except Exception:  # noqa: BLE001 — incl. injected fleet.pull faults
+            return None
+
+    def pull_once(self) -> dict:
+        """One full federated pull + merge + SLO evaluation.  Returns
+        the merged fleet view (also cached for the endpoints)."""
+        t0 = time.perf_counter()
+        reps = self.gateway.replicas()  # brief lock; copied list
+        sources: Dict[str, dict] = {
+            "gateway": telemetry.export_snapshot(include_spans=False)}
+        versions: Dict[str, str] = {}
+        failed: List[str] = []
+        for rep in reps:
+            snap = self._get_json(rep.info.host, rep.info.port,
+                                  "/metrics.json")
+            if snap is None:
+                failed.append(rep.key)
+                telemetry.incr("fleet.pull_failed")
+                telemetry.incr(f"fleet.pull_failed.{rep.key}")
+                # dead-between-syncs replica: unroutable NOW, through
+                # the same path as an active probe failure
+                self.gateway._mark_probe(rep, ok=False,
+                                         draining=rep.draining)
+                continue
+            sources[rep.key] = snap
+            versions[rep.key] = rep.version
+        merged = telemetry.merge_snapshots(sources, versions)
+        merged["meta"]["gateway"] = self.gateway.name
+        merged["meta"]["failed"] = failed
+        telemetry.incr("fleet.pull")
+        telemetry.gauge("fleet.pull.replicas").set(len(sources) - 1)
+        telemetry.histogram("fleet.scrape.latency").observe(
+            time.perf_counter() - t0)
+        self.engine.observe(merged)
+        with self._lock:
+            self._merged = merged
+            self._last_pull = time.monotonic()
+        return merged
+
+    def ensure_fresh(self, max_age_s: Optional[float] = None) -> dict:
+        """The cached merged view, re-pulled when never pulled or older
+        than `max_age_s` (default: the pull interval, else 0.5 s) — so a
+        gateway without a background puller still answers /fleet/*."""
+        if max_age_s is None:
+            max_age_s = self.pull_interval_s or 0.5
+        with self._lock:
+            merged = self._merged
+            fresh = (merged is not None
+                     and time.monotonic() - self._last_pull < max_age_s)
+        if fresh:
+            return merged
+        return self.pull_once()
+
+    def merged(self) -> Optional[dict]:
+        with self._lock:
+            return self._merged
+
+    # ---- trace stitching -----------------------------------------------
+
+    def fetch_trace(self, trace_id: str) -> dict:
+        """Fan one trace id out to every replica's ``/trace/<id>`` and
+        stitch the gateway's own spans plus every hop's into one tree."""
+        sources: Dict[str, list] = {
+            "gateway": telemetry.get_trace(trace_id)}
+        for rep in self.gateway.replicas():
+            data = self._get_json(rep.info.host, rep.info.port,
+                                  f"/trace/{trace_id}")
+            if data and data.get("spans"):
+                sources[rep.key] = data["spans"]
+        return telemetry.stitch_spans(trace_id, sources)
+
+    def _worst_trace_ids(self) -> List[str]:
+        """Trace ids of the slowest recent gateway requests — what the
+        flight recorder stitches into the incident bundle."""
+        reqs = [r for r in telemetry.recent_spans()
+                if r.get("name") == "serving.fleet.request"]
+        reqs.sort(key=lambda r: r.get("wall_s", 0.0), reverse=True)
+        out: List[str] = []
+        for r in reqs:
+            tid = r.get("trace_id")
+            if tid and tid not in out:
+                out.append(tid)
+            if len(out) >= self.worst_traces:
+                break
+        return out
+
+    # ---- incident hook -------------------------------------------------
+
+    def _on_transition(self, slo, old: str, new: str, info: dict) -> None:
+        if new != "firing" or self.recorder is None:
+            return
+        try:
+            traces = {tid: self.fetch_trace(tid)
+                      for tid in self._worst_trace_ids()}
+            self.recorder.dump(
+                f"slo_{slo.name}",
+                merged=self.merged(),
+                traces=traces,
+                records=telemetry.recent_records()[-100:],
+                health=self.gateway.describe(),
+                alerts=self.engine.alerts())
+        except Exception:  # noqa: BLE001 — recording must never break eval
+            pass
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self.pull_interval_s is None or self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"fleet-pull-{self.gateway.name}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.pull_interval_s):
+            try:
+                self.pull_once()
+            except Exception:  # noqa: BLE001 — puller must survive anything
+                pass
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    def describe(self) -> dict:
+        with self._lock:
+            age = (time.monotonic() - self._last_pull
+                   if self._merged is not None else None)
+            n = (self._merged["meta"]["replica_count"]
+                 if self._merged else 0)
+        return {
+            "pull_interval_s": self.pull_interval_s,
+            "last_pull_age_s": round(age, 3) if age is not None else None,
+            "sources": n,
+            "alerts": {a["slo"]: a["state"]
+                       for a in self.engine.alerts()},
+            "incidents": (self.recorder.bundles()
+                          if self.recorder else []),
+        }

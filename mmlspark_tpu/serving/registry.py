@@ -13,6 +13,15 @@ than ``ttl_s`` are expired on every read so a dead worker stops being
 discoverable within one TTL, and ``POST /deregister`` removes an entry
 immediately (the graceful half — the gateway uses it when it drains a
 replica out of a fleet, serving/fleet.py).
+
+TTL caveat: expiry is evaluated on READ only — nothing here pushes a
+death notification, so a replica that dies between a gateway's registry
+syncs remains listed (and, on the gateway, routable) until the next
+sync/probe notices.  The gateway-side close for that gap is the
+federated telemetry puller (serving/fleet.py FleetTelemetry): a failed
+``/metrics.json`` pull marks the replica unhealthy immediately through
+the probe/breaker path.  ``prune()`` is the explicit server-side sweep
+for operators/tests that want expiry without a read.
 """
 from __future__ import annotations
 
@@ -114,6 +123,13 @@ class ServiceRegistry:
         for k in [k for k, v in self._services.items()
                   if v.get("_last_seen", 0.0) < cutoff]:
             del self._services[k]
+
+    def prune(self) -> int:
+        """Explicit TTL sweep (the read path runs this implicitly).
+        Returns the number of entries remaining."""
+        with self._lock:
+            self._prune_locked()
+            return len(self._services)
 
     @staticmethod
     def _public(entry: dict) -> dict:

@@ -51,7 +51,7 @@ from ..io.http.schema import HTTPRequestData
 from ..utils.sync import make_lock
 from .fleet import FleetGateway, Replica
 
-__all__ = ["RolloutController", "ROLLOUT_METRICS"]
+__all__ = ["RolloutController", "ROLLOUT_METRICS", "drain_and_stop"]
 
 # metric -> (direction, relative tolerance, absolute floor) — the
 # perf_gate band shape (tools/perf_gate.py GATE_METRICS).
@@ -86,6 +86,44 @@ def _band_compare(fresh: Dict[str, Any], base: Dict[str, Any],
                          "delta_pct": ((f - b) / b * 100.0) if b else None,
                          "regressed": worse_by > band})
         return rows
+
+
+def drain_and_stop(gateway: FleetGateway, rep: Replica,
+                   drain_timeout_s: float = 10.0) -> None:
+    """Gracefully retire one replica: begin_drain -> wait drained
+    (bounded) -> stop.  In-process via the ServingServer handle, or
+    remotely via ``POST /admin/drain`` + ``/health`` polling (a remote
+    replica's process is stopped by its owner; the gateway just stops
+    routing to it).  The drain mark goes through the gateway so it is
+    sticky: a health probe racing this drain (remote /health still says
+    draining=false) must not flip the replica back to routable.
+
+    Shared by RolloutController (promote/rollback retirements) and
+    AutoscaleController (scale-down) — one drain discipline, no
+    accepted request dropped by either control loop."""
+    gateway.begin_drain(rep.key)
+    deadline = time.monotonic() + drain_timeout_s
+    if rep.server is not None:
+        rep.server.server.begin_drain()
+        while (time.monotonic() < deadline
+               and not rep.server.server.drained()):
+            time.sleep(0.01)
+        rep.server.stop(drain=False)  # already drained above
+        return
+    base = f"http://{rep.info.host}:{rep.info.port}"
+    try:
+        send_request(HTTPRequestData(
+            url=base + "/admin/drain",
+            headers={"Content-Type": "application/json"},
+            entity=b"{}"), timeout=5.0)
+        while time.monotonic() < deadline:
+            resp = send_request(HTTPRequestData(
+                url=base + "/health", method="GET"), timeout=2.0)
+            if resp.ok and resp.json().get("drained"):
+                break
+            time.sleep(0.05)
+    except Exception:  # noqa: BLE001 — replica died mid-drain: done
+        pass
 
 
 class RolloutController:
@@ -232,36 +270,7 @@ class RolloutController:
 
     # ---- rolling drain -------------------------------------------------
     def _drain_and_stop(self, rep: Replica) -> None:
-        """begin_drain -> wait drained (bounded) -> stop, in-process via
-        the ServingServer handle or remotely via /admin/drain + /health
-        polling (a remote replica's process is stopped by its owner; the
-        gateway just stops routing to it).  The drain mark goes through
-        the gateway so it is sticky: a health probe racing this drain
-        (remote /health still says draining=false) must not flip the
-        replica back to routable."""
-        self.gateway.begin_drain(rep.key)
-        deadline = time.monotonic() + self.drain_timeout_s
-        if rep.server is not None:
-            rep.server.server.begin_drain()
-            while (time.monotonic() < deadline
-                   and not rep.server.server.drained()):
-                time.sleep(0.01)
-            rep.server.stop(drain=False)  # already drained above
-            return
-        base = f"http://{rep.info.host}:{rep.info.port}"
-        try:
-            send_request(HTTPRequestData(
-                url=base + "/admin/drain",
-                headers={"Content-Type": "application/json"},
-                entity=b"{}"), timeout=5.0)
-            while time.monotonic() < deadline:
-                resp = send_request(HTTPRequestData(
-                    url=base + "/health", method="GET"), timeout=2.0)
-                if resp.ok and resp.json().get("drained"):
-                    break
-                time.sleep(0.05)
-        except Exception:  # noqa: BLE001 — replica died mid-drain: done
-            pass
+        drain_and_stop(self.gateway, rep, self.drain_timeout_s)
 
     # ---- optional background stepping ---------------------------------
     def run(self, poll_s: float = 1.0) -> threading.Thread:

@@ -287,6 +287,20 @@ class WorkerServer:
                         {"Content-Type":
                          "text/plain; version=0.0.4; charset=utf-8"})
                     return
+                if path.rstrip("/") == "/metrics.json":
+                    # the federated-pull wire format: the full registry
+                    # as an export_snapshot dict (what the gateway's
+                    # FleetTelemetry merges across the pool)
+                    try:
+                        telemetry.sample_device_memory()
+                    except Exception:
+                        pass
+                    payload = json.dumps(
+                        telemetry.export_snapshot(include_spans=False),
+                        default=repr).encode("utf-8")
+                    self._reply_bytes(200, payload,
+                                      {"Content-Type": "application/json"})
+                    return
                 if path.rstrip("/") == "/trace.json":
                     payload = json.dumps(
                         telemetry.render_chrome_trace()).encode("utf-8")

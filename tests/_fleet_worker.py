@@ -1,0 +1,43 @@
+"""Subprocess replica worker for tests/test_fleet_obs.py.
+
+Starts one real ServingServer (y = 3*v, the fleet-soak contract) in its
+OWN process — its own telemetry registry, span store, and sockets —
+prints the bound address as one JSON line on stdout, then blocks until
+the parent closes stdin.  The federation tests need this: in-process
+replicas share the single process-global registry, so only subprocess
+replicas exercise the exact-merge and cross-process trace-stitching
+paths the way a real pool does.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import numpy as np
+
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+    from mmlspark_tpu.serving import ServingServer
+
+    def fn(table):
+        v = np.asarray(table["v"], np.int64)
+        return table.with_column("y", v * 3)
+
+    srv = ServingServer(
+        LambdaTransformer(fn), reply_col="y", name="fleet-worker",
+        host="127.0.0.1", port=0, input_schema=["v"],
+        max_batch=8, batch_timeout_ms=5.0)
+    srv.server.handler_timeout = 1.5
+    info = srv.start()
+    print(json.dumps({"name": info.name, "host": info.host,
+                      "port": info.port, "path": info.path}), flush=True)
+    try:
+        sys.stdin.read()  # parent closes our stdin to shut us down
+    finally:
+        srv.stop(drain=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,724 @@
+"""Fleet observability tests (PR 15, docs/observability.md): federated
+metrics merging, cross-process trace stitching, the SLO burn-rate state
+machine, the autoscale signal bus, the flight recorder, and the
+registry-TTL-on-read regression.
+
+The federation tests run against REAL subprocess replicas
+(tests/_fleet_worker.py): in-process servers share the one
+process-global registry, so every in-process source would export the
+same snapshot and the exact-merge property would be vacuous.  Three
+worker processes plus the gateway's own process give the >= 3 distinct
+span stores the stitching contract is about.
+"""
+import importlib.util
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from pathlib import Path
+
+import pytest
+
+from mmlspark_tpu.core import telemetry
+from mmlspark_tpu.core.telemetry import fleet as tfleet
+from mmlspark_tpu.core.telemetry.metrics import BUCKET_FAMILIES, Histogram
+from mmlspark_tpu.io.http.clients import send_request
+from mmlspark_tpu.io.http.schema import HTTPRequestData, to_http_request
+from mmlspark_tpu.serving import FleetGateway, ServiceInfo, ServingServer
+from mmlspark_tpu.serving.autoscale import AutoscaleController, CapacityModel
+from mmlspark_tpu.utils.faults import VirtualClock
+
+ROOT = Path(__file__).resolve().parent.parent
+WORKER = Path(__file__).resolve().parent / "_fleet_worker.py"
+
+LATENCY = BUCKET_FAMILIES["latency"]
+
+
+def _counter(name):
+    return telemetry.counters().get(name, 0)
+
+
+def _gw_name(tag):
+    # breaker registry keys are process-global and config applies on
+    # first construction: a unique gateway name per test isolates them
+    return f"{tag}-{uuid.uuid4().hex[:8]}"
+
+
+def _mk_server(**kw):
+    import numpy as np
+
+    from mmlspark_tpu.core.pipeline import LambdaTransformer
+
+    def fn(table):
+        v = np.asarray(table["v"], np.int64)
+        return table.with_column("y", v * 3)
+
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("batch_timeout_ms", 5.0)
+    return ServingServer(LambdaTransformer(fn), reply_col="y",
+                         name="fleet-obs-test", input_schema=["v"], **kw)
+
+
+def _post(url, payload, headers=None, timeout=10.0):
+    return send_request(to_http_request(url, payload, headers=headers),
+                        timeout=timeout)
+
+
+def _get(url, timeout=10.0):
+    return send_request(HTTPRequestData(url=url, method="GET"),
+                        timeout=timeout)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, ROOT / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# histogram merge exactness (pure units)
+# ---------------------------------------------------------------------------
+
+def _json_roundtrip(snap):
+    """What a replica's snapshot looks like after the /metrics.json
+    wire trip: the +Inf edge in its JSON spelling."""
+    wire = dict(snap)
+    wire["buckets"] = [["+Inf" if le == math.inf else le, cum]
+                      for le, cum in snap["buckets"]]
+    return json.loads(json.dumps(wire))
+
+
+class TestHistogramMerge:
+    def test_merge_exactness_through_json_roundtrip(self):
+        h1, h2 = Histogram("a", LATENCY), Histogram("b", LATENCY)
+        vals1 = [1e-5, 3e-4, 0.002, 0.002, 0.4, 2.0]
+        vals2 = [7e-6, 0.03, 0.03, 0.9]
+        for v in vals1:
+            h1.observe(v)
+        for v in vals2:
+            h2.observe(v)
+        parts = [_json_roundtrip(h1.snapshot()),
+                 _json_roundtrip(h2.snapshot())]
+        merged = telemetry.merge_histogram_snapshots(parts, key="a")
+        assert merged["count"] == len(vals1) + len(vals2)
+        assert merged["sum"] == pytest.approx(sum(vals1) + sum(vals2))
+        # cumulative buckets add element-wise — the exactness contract
+        for i, (le, cum) in enumerate(merged["buckets"]):
+            want = sum(int(p["buckets"][i][1]) for p in parts)
+            assert cum == want, f"bucket le={le} inexact"
+        # percentiles recomputed from the merged ladder match a single
+        # histogram holding the union of observations
+        union = Histogram("u", LATENCY)
+        for v in vals1 + vals2:
+            union.observe(v)
+        for q, k in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            assert merged[k] == pytest.approx(union.percentile(q))
+
+    def test_mismatched_edges_raise(self):
+        h1 = Histogram("a", (0.1, 1.0))
+        h2 = Histogram("b", (0.2, 1.0))
+        h1.observe(0.05)
+        h2.observe(0.05)
+        with pytest.raises(ValueError, match="bucket edges differ"):
+            telemetry.merge_histogram_snapshots(
+                [h1.snapshot(), h2.snapshot()], key="a")
+
+    def test_merge_snapshots_counters_sum_gauges_split(self):
+        src_a = {"meta": {"pid": 1},
+                 "counters": {"req": 3, "only_a": 1},
+                 "gauges": {"queue": 2.0},
+                 "histograms": {}}
+        src_b = {"meta": {"pid": 2},
+                 "counters": {"req": 5},
+                 "gauges": {"queue": 7.0},
+                 "histograms": {}}
+        m = telemetry.merge_snapshots({"a:1": src_a, "b:2": src_b},
+                                      versions={"a:1": "v1"})
+        assert m["counters"] == {"req": 8, "only_a": 1}
+        assert m["counters_by_replica"]["b:2"] == {"req": 5}
+        # gauges keep the per-replica split; consumers fold
+        assert m["gauges"]["queue"] == {"a:1": 2.0, "b:2": 7.0}
+        assert m["meta"]["replica_count"] == 2
+        assert m["replicas"]["a:1"]["version"] == "v1"
+
+    def test_render_fleet_prometheus_sums_exactly(self):
+        h = Histogram("h", (0.1, 1.0))
+        for v in (0.05, 0.5, 0.5):
+            h.observe(v)
+        snap = _json_roundtrip(h.snapshot())
+        src = {"counters": {"req": 2}, "gauges": {},
+               "histograms": {"serving.request.latency": snap}}
+        m = telemetry.merge_snapshots({"a:1": src, "b:2": src})
+        text = telemetry.render_fleet_prometheus(m)
+        assert 'req{replica="a:1"} 2' in text
+        assert "\nreq 4" in text
+        assert ('serving_request_latency_count{replica="a:1"} 3'
+                in text)
+        assert "\nserving_request_latency_count 6" in text
+
+
+# ---------------------------------------------------------------------------
+# span stitching (pure units)
+# ---------------------------------------------------------------------------
+
+class TestStitchSpans:
+    def test_cross_source_nesting_and_dedup(self):
+        tid = "t1"
+        root = {"trace_id": tid, "span_id": "g1", "parent_id": None,
+                "t_start": 1.0, "name": "gw.request"}
+        child = {"trace_id": tid, "span_id": "r1", "parent_id": "g1",
+                 "t_start": 1.1, "name": "replica.handle"}
+        other = {"trace_id": "other", "span_id": "x", "parent_id": None,
+                 "t_start": 0.5, "name": "noise"}
+        stitched = telemetry.stitch_spans(tid, {
+            "gateway": [root, other],
+            # replica probed twice: same records twice, plus the
+            # gateway's root re-reported — all dedupe by span_id
+            "r:1": [child, child, dict(root)],
+        })
+        assert stitched["span_count"] == 2
+        assert stitched["sources"] == ["gateway", "r:1"]
+        assert len(stitched["tree"]) == 1
+        top = stitched["tree"][0]
+        assert top["span_id"] == "g1" and top["source"] == "gateway"
+        # the cross-process edge: a child whose parent lives in
+        # ANOTHER process still nests under it
+        assert [c["span_id"] for c in top["children"]] == ["r1"]
+        assert top["children"][0]["source"] == "r:1"
+
+
+# ---------------------------------------------------------------------------
+# SLO state machine under a VirtualClock
+# ---------------------------------------------------------------------------
+
+def _availability_slo(**kw):
+    def good_total(m):
+        g = m.get("gauges") or {}
+        return (sum((g.get("healthy") or {}).values()),
+                sum((g.get("replicas") or {}).values()))
+
+    kw.setdefault("fast_window_s", 0.5)
+    kw.setdefault("slow_window_s", 1.0)
+    kw.setdefault("burn_threshold", 10.0)
+    return telemetry.SLO("availability", 0.999, good_total,
+                         kind="instant", **kw)
+
+
+def _view(healthy, total):
+    return {"gauges": {"healthy": {"gw": float(healthy)},
+                       "replicas": {"gw": float(total)}}}
+
+
+class TestSLOEngine:
+    def test_pending_firing_resolved_inactive(self):
+        vc = VirtualClock()
+        slo = _availability_slo(for_s=1.0)
+        eng = telemetry.SLOEngine([slo], clock=vc.monotonic)
+        seen = []
+        eng.on_transition(lambda s, old, new, info:
+                          seen.append((old, new, dict(info))))
+        c0 = _counter("slo.alert.firing")
+
+        eng.observe(_view(3, 3))
+        assert eng.state("availability") == "inactive"
+
+        eng.observe(_view(1, 3))            # burn hits, dwell starts
+        assert eng.state("availability") == "pending"
+        vc.advance(0.4)
+        eng.observe(_view(1, 3))            # still inside for_s
+        assert eng.state("availability") == "pending"
+        vc.advance(0.7)
+        eng.observe(_view(1, 3))            # dwell elapsed -> firing
+        assert eng.state("availability") == "firing"
+        assert _counter("slo.alert.firing") == c0 + 1
+        assert _counter("slo.alert.firing.availability") >= 1
+
+        # recovery: burn stays hot until the bad samples age out of
+        # BOTH windows (the multi-window guard), then firing->resolved
+        vc.advance(1.5)
+        eng.observe(_view(3, 3))
+        assert eng.state("availability") == "resolved"
+        vc.advance(0.1)
+        eng.observe(_view(3, 3))
+        assert eng.state("availability") == "inactive"
+
+        assert [(o, n) for o, n, _i in seen] == [
+            ("inactive", "pending"), ("pending", "firing"),
+            ("firing", "resolved")]
+        # listener info is the alert snapshot taken under the lock
+        assert seen[1][2]["state"] == "firing"
+        assert seen[1][2]["burn_fast"] >= slo.burn_threshold
+
+    def test_pending_clears_without_firing(self):
+        vc = VirtualClock()
+        slo = _availability_slo(for_s=10.0, fast_window_s=0.5,
+                                slow_window_s=0.5)
+        eng = telemetry.SLOEngine([slo], clock=vc.monotonic)
+        eng.observe(_view(0, 2))
+        assert eng.state("availability") == "pending"
+        vc.advance(1.0)                     # bad sample leaves the window
+        eng.observe(_view(2, 2))
+        assert eng.state("availability") == "inactive"
+
+    def test_alerts_shape_and_burn_gauge(self):
+        vc = VirtualClock()
+        eng = telemetry.SLOEngine([_availability_slo(for_s=0.0)],
+                                  clock=vc.monotonic)
+        alerts = eng.observe(_view(0, 2))
+        (a,) = alerts
+        assert a["slo"] == "availability" and a["state"] == "firing"
+        assert a["burn_fast"] >= 10.0 and a["burn_slow"] >= 10.0
+        snap = telemetry.export_snapshot(include_spans=False)
+        assert snap["gauges"]["slo.burn_rate.availability"] > 0
+
+
+# ---------------------------------------------------------------------------
+# capacity model (pure math on dict fixtures)
+# ---------------------------------------------------------------------------
+
+def _fill_merged(p50_hi=True):
+    # fill ladder slice where p50 lands at 0.925 (hi) or 0.075 (lo)
+    edges = [[0.15, 0 if p50_hi else 10], [0.85, 0 if p50_hi else 10],
+             [1.0, 10], ["+Inf", 10]]
+    return {"gauges": {}, "histograms": {
+        "serving.batch.fill": {"count": 10, "sum": 9.0 if p50_hi else 0.7,
+                               "buckets": edges}}}
+
+
+class TestCapacityModel:
+    def test_availability_burn_restores_registered_strength(self):
+        m = CapacityModel(min_replicas=1, max_replicas=8)
+        rec = m.recommend({"gauges": {}, "histograms": {}},
+                          [{"slo": "availability", "state": "firing",
+                            "burn_fast": 50.0}],
+                          n_routable=1, n_registered=3)
+        assert rec["target"] == 3
+        assert any("replace dead" in r for r in rec["reasons"])
+
+    def test_latency_burn_adds_capacity(self):
+        m = CapacityModel(min_replicas=1, max_replicas=8)
+        rec = m.recommend({"gauges": {}, "histograms": {}},
+                          [{"slo": "latency_p99", "state": "pending",
+                            "burn_fast": 20.0}],
+                          n_routable=2, n_registered=2)
+        assert rec["target"] == 3
+
+    def test_queue_depth_sets_demand_floor(self):
+        m = CapacityModel(target_queue_per_replica=8.0, max_replicas=8)
+        merged = {"gauges": {"serving.queue.depth":
+                             {"r1": 20.0, "r2": 12.0, "gateway": 99.0}},
+                  "histograms": {}}
+        rec = m.recommend(merged, [], n_routable=2, n_registered=2)
+        # gateway's gauge is excluded; ceil(32/8) = 4
+        assert rec["target"] == 4
+
+    def test_fill_pressure_and_idle_scale_down(self):
+        m = CapacityModel(min_replicas=1, max_replicas=8)
+        rec = m.recommend(_fill_merged(p50_hi=True), [],
+                          n_routable=2, n_registered=2)
+        assert rec["target"] == 3
+        rec = m.recommend(_fill_merged(p50_hi=False), [],
+                          n_routable=3, n_registered=3)
+        assert rec["target"] == 2          # one step down, never more
+        rec = m.recommend(_fill_merged(p50_hi=False), [],
+                          n_routable=1, n_registered=1)
+        assert rec["target"] == 1          # min clamp
+
+    def test_max_clamp(self):
+        m = CapacityModel(target_queue_per_replica=1.0, max_replicas=4)
+        merged = {"gauges": {"serving.queue.depth": {"r1": 100.0}},
+                  "histograms": {}}
+        rec = m.recommend(merged, [], n_routable=2, n_registered=2)
+        assert rec["target"] == 4
+
+
+# ---------------------------------------------------------------------------
+# autoscale controller: hysteresis, cooldown, dead-GC, scale-down
+# ---------------------------------------------------------------------------
+
+class TestAutoscaleController:
+    def test_hysteresis_and_cooldown_gate_actions(self):
+        vc = VirtualClock(start=100.0)
+        gw = FleetGateway(name=_gw_name("as-hyst"), probe_interval_s=60.0)
+        provisions = []
+
+        class _Up(CapacityModel):
+            def recommend(self, merged, alerts, n_routable, n_registered):
+                return {"target": n_routable + 2, "routable": n_routable,
+                        "registered": n_registered, "reasons": ["stub"],
+                        "inputs": {}}
+
+        ctl = AutoscaleController(
+            gw, provisioner=lambda n: provisions.append(n) or n,
+            model=_Up(), cooldown_s=10.0, hysteresis=2,
+            clock=vc.monotonic)
+        try:
+            assert ctl.evaluate_once()["action"] == "none"  # 1 vote < hyst
+            assert ctl.evaluate_once()["action"] == "up+2"
+            assert provisions == [2]
+            # agreement continues but the cooldown gates the next act
+            # (these cooled votes refill the hysteresis window)
+            assert ctl.evaluate_once()["action"] == "none"
+            assert ctl.evaluate_once()["action"] == "none"
+            vc.advance(11.0)
+            assert ctl.evaluate_once()["action"] == "up+2"
+            assert provisions == [2, 2]
+            assert gw.describe()["autoscale"]["hysteresis"] == 2
+        finally:
+            gw._httpd.server_close()        # never start()ed
+
+    def test_dead_replica_gc_shrinks_registered_set(self):
+        vc = VirtualClock(start=5.0)
+        gw = FleetGateway(name=_gw_name("as-gc"), probe_interval_s=60.0)
+        rep = gw.add_replica(ServiceInfo(name="dead", host="127.0.0.1",
+                                         port=1, path="/"))
+        rep.healthy = False                 # prober would have marked it
+        ctl = AutoscaleController(gw, cooldown_s=1e9, hysteresis=99,
+                                  dead_grace_s=0.5, clock=vc.monotonic)
+        try:
+            rec = ctl.evaluate_once()
+            assert rec["gc_removed"] == [] and len(gw.replicas()) == 1
+            vc.advance(0.6)                 # grace elapses
+            rec = ctl.evaluate_once()
+            assert rec["gc_removed"] == [rep.key]
+            assert gw.replicas() == []
+        finally:
+            gw._httpd.server_close()        # never start()ed
+
+    def test_scale_down_drains_least_loaded(self):
+        servers = [_mk_server() for _ in range(2)]
+        gw = FleetGateway(name=_gw_name("as-down"), probe_interval_s=60.0)
+        try:
+            for s in servers:
+                s.start()
+                gw.add_server(s, version="v1")
+
+            class _Down(CapacityModel):
+                def recommend(self, merged, alerts, n_routable,
+                              n_registered):
+                    return {"target": n_routable - 1,
+                            "routable": n_routable,
+                            "registered": n_registered,
+                            "reasons": ["stub"], "inputs": {}}
+
+            c0 = _counter("autoscale.down")
+            ctl = AutoscaleController(gw, model=_Down(min_replicas=1),
+                                      cooldown_s=0.0, hysteresis=1,
+                                      drain_timeout_s=5.0)
+            rec = ctl.evaluate_once()
+            assert rec["action"] == "down-1"
+            assert len(gw.replicas()) == 1
+            assert _counter("autoscale.down") == c0 + 1
+            # the floor holds: a second step-down recommendation at
+            # min_replicas is refused, not half-applied
+            rec = ctl.evaluate_once()
+            assert rec["action"] == "down_failed"
+            assert len(gw.replicas()) == 1
+        finally:
+            gw._httpd.server_close()        # never start()ed
+            for s in servers:
+                try:
+                    s.stop(drain=False)
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# registry-TTL regression: a pull failure ejects the replica NOW
+# ---------------------------------------------------------------------------
+
+class TestPullFailureEjects:
+    def test_dead_between_probes_replica_unroutable_after_pull(self):
+        servers = [_mk_server() for _ in range(2)]
+        gw = FleetGateway(name=_gw_name("ttl-reg"), probe_interval_s=60.0)
+        try:
+            for s in servers:
+                s.start()
+                gw.add_server(s, version="v1")
+            victim = gw.replicas()[0]
+            c_eject = _counter("serving.fleet.eject")
+            c_fail = _counter("fleet.pull_failed")
+            # hard-kill between probe ticks: with the prober 60 s away,
+            # nothing else notices — the federated pull must
+            victim.server.stop(drain=False)
+            assert victim.routable()        # the stale-registry hole
+            merged = gw.telemetry_plane.pull_once()
+            assert not victim.routable()
+            assert not victim.healthy
+            assert merged["meta"]["failed"] == [victim.key]
+            assert _counter("serving.fleet.eject") == c_eject + 1
+            assert _counter("fleet.pull_failed") == c_fail + 1
+            assert _counter(f"fleet.pull_failed.{victim.key}") >= 1
+            # the survivor still contributes a source
+            assert len(merged["meta"]["sources"]) == 2  # gateway + 1
+        finally:
+            gw._httpd.server_close()        # never start()ed
+            for s in servers:
+                try:
+                    s.stop(drain=False)
+                except Exception:
+                    pass
+
+    def test_scrape_never_holds_the_routing_lock(self):
+        srv = _mk_server()
+        gw = FleetGateway(name=_gw_name("scrape-lock"),
+                          probe_interval_s=60.0)
+        plane = gw.telemetry_plane
+        inner = plane._get_json
+        release = threading.Event()
+        try:
+            srv.start()
+            gw.add_server(srv, version="v1")
+            gw.start()
+
+            def slow_get(host, port, path):
+                release.wait(timeout=5.0)
+                return inner(host, port, path)
+
+            plane._get_json = slow_get
+            puller = threading.Thread(target=plane.pull_once, daemon=True)
+            puller.start()
+            time.sleep(0.05)                # puller is inside the scrape
+            t0 = time.perf_counter()
+            gw.replicas()                   # routing-lock acquisition
+            r = _post(gw.url, {"v": 5})     # a full routed request
+            waited = time.perf_counter() - t0
+            assert r.status_code == 200 and r.json() == {"y": 15}
+            assert waited < 2.0, \
+                f"routing stalled {waited:.2f}s behind a slow scrape"
+            release.set()
+            puller.join(timeout=10.0)
+            assert not puller.is_alive()
+        finally:
+            release.set()
+            plane._get_json = inner
+            gw.stop()
+            try:
+                srv.stop(drain=False)
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + report renderers
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_bundle_contents_and_prune(self, tmp_path):
+        rec = telemetry.FlightRecorder(str(tmp_path), max_bundles=2)
+        c0 = _counter("fleet.incident")
+        merged = telemetry.merge_snapshots(
+            {"gateway": telemetry.export_snapshot(include_spans=False)})
+        for i in range(3):
+            rec.dump(f"slo availability #{i}", merged=merged,
+                     alerts=[{"slo": "availability", "state": "firing"}])
+        bundles = rec.bundles()
+        assert len(bundles) == 2            # oldest pruned
+        assert _counter("fleet.incident") == c0 + 3
+        manifest = json.loads(
+            (Path(bundles[-1]) / "MANIFEST.json").read_text())
+        assert manifest["reason"] == "slo availability #2"
+        assert manifest["files"] == ["alerts.json", "snapshot.json"]
+        snap = json.loads((Path(bundles[-1]) / "snapshot.json").read_text())
+        assert snap["meta"]["replica_count"] == 1
+        # no half-written .tmp-* turds left behind
+        assert not [d for d in os.listdir(tmp_path / "incidents")
+                    if d.startswith(".")]
+
+    def test_obs_report_renders_fleet_and_incident(self, tmp_path):
+        obs_report = _load_tool("obs_report")
+        h = Histogram("h", LATENCY)
+        for v in (0.01, 0.02, 0.4):
+            h.observe(v)
+        src = {"counters": {"serving.fleet.request": 3},
+               "gauges": {"serving.fleet.healthy": 2.0},
+               "histograms": {"serving.request.latency":
+                              _json_roundtrip(h.snapshot())}}
+        merged = telemetry.merge_snapshots({"gateway": src, "r:1": src})
+        alerts = [{"slo": "availability", "state": "firing",
+                   "burn_fast": 42.0, "burn_slow": 12.0}]
+        text = obs_report.render_fleet_report(merged, alerts=alerts)
+        assert "r:1" in text and "availability" in text
+        assert "firing" in text
+        assert "serving.request.latency" in text
+
+        rec = telemetry.FlightRecorder(str(tmp_path))
+        bundle = rec.dump("slo availability", merged=merged, alerts=alerts)
+        itext = obs_report.render_incident(bundle)
+        assert "slo availability" in itext and "firing" in itext
+
+
+# ---------------------------------------------------------------------------
+# the real thing: subprocess replicas behind a live gateway
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def worker_pool(tmp_path_factory):
+    """Three subprocess replicas — each its own registry + span store."""
+    logdir = tmp_path_factory.mktemp("workers")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs, infos, logs = [], [], []
+    for i in range(3):
+        log = open(logdir / f"worker{i}.err", "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(WORKER)], stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=log, env=env, text=True))
+    for i, p in enumerate(procs):
+        line = p.stdout.readline()
+        if not line:
+            logs[i].seek(0)
+            raise RuntimeError(
+                f"fleet worker {i} died at startup:\n{logs[i].read()}")
+        infos.append(json.loads(line))
+    try:
+        yield infos
+    finally:
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
+
+
+@pytest.fixture
+def fleet_gw(worker_pool):
+    gw = FleetGateway(name=_gw_name("fedobs"), probe_interval_s=5.0,
+                      retries=3)
+    for info in worker_pool:
+        gw.add_replica(ServiceInfo(name=info["name"], host=info["host"],
+                                   port=info["port"], path=info["path"]))
+    gw.start()
+    try:
+        yield gw
+    finally:
+        gw.stop()
+
+
+def _wave(gw, ids, headers_for=None, concurrency=8):
+    results = {}
+    lock = threading.Lock()
+    sem = threading.BoundedSemaphore(concurrency)
+
+    def run(i):
+        try:
+            hdrs = headers_for(i) if headers_for else None
+            r = _post(gw.url, {"v": i}, headers=hdrs)
+            with lock:
+                results[i] = (r.status_code, r.json())
+        finally:
+            sem.release()
+
+    threads = []
+    for i in ids:
+        sem.acquire()
+        t = threading.Thread(target=run, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=30.0)
+    return results
+
+
+class TestFederatedFleet:
+    def test_fleet_metrics_merge_is_exact_across_processes(self, fleet_gw):
+        results = _wave(fleet_gw, range(12))
+        assert all(results[i] == (200, {"y": 3 * i}) for i in range(12))
+        r = _get(f"{fleet_gw.url.rsplit('/', 1)[0]}/fleet/metrics.json")
+        assert r.status_code == 200
+        merged = r.json()
+        # gateway + 3 subprocess replicas, each a DISTINCT registry
+        assert merged["meta"]["replica_count"] == 4
+        assert merged["meta"]["failed"] == []
+        by_hist = merged["histograms_by_replica"]
+        for hkey, snap in merged["histograms"].items():
+            parts = [by_hist[rk][hkey] for rk in by_hist
+                     if hkey in by_hist[rk]]
+            assert snap["count"] == sum(p["count"] for p in parts), hkey
+            assert snap["sum"] == pytest.approx(
+                sum(p["sum"] for p in parts)), hkey
+            for i, (_le, cum) in enumerate(snap["buckets"]):
+                assert cum == sum(int(p["buckets"][i][1])
+                                  for p in parts), hkey
+        by_ctr = merged["counters_by_replica"]
+        for name, total in merged["counters"].items():
+            assert total == sum(c.get(name, 0) for c in by_ctr.values()), \
+                name
+        # the 12 requests landed across the worker processes, summed
+        # exactly into the fleet series (workers are fresh registries)
+        worker_keys = [k for k in by_hist if k != "gateway"]
+        assert len(worker_keys) == 3
+        served = sum(
+            snap["count"]
+            for rk in worker_keys
+            for hk, snap in by_hist[rk].items()
+            if tfleet.parse_hist_key(hk)[0] == "serving.request.latency")
+        assert served >= 12
+
+        # Prometheus rendering of the same view: per-replica labels
+        # plus the unlabeled exact aggregate
+        rp = _get(f"{fleet_gw.url.rsplit('/', 1)[0]}/fleet/metrics")
+        assert rp.status_code == 200
+        text = rp.entity.decode("utf-8") if isinstance(rp.entity, bytes) \
+            else rp.entity
+        assert 'replica="gateway"' in text
+        assert "# TYPE serving_request_latency histogram" in text
+
+    def test_trace_stitching_under_concurrent_traffic(self, fleet_gw):
+        tids = {i: f"obs-{uuid.uuid4().hex}" for i in range(12)}
+        results = _wave(fleet_gw, range(12),
+                        headers_for=lambda i: {"X-Trace-Id": tids[i]})
+        assert all(results[i][0] == 200 for i in range(12))
+        base = fleet_gw.url.rsplit("/", 1)[0]
+        replica_sources = set()
+        for i, tid in tids.items():
+            r = _get(f"{base}/trace/{tid}")
+            assert r.status_code == 200
+            stitched = r.json()
+            assert stitched["trace_id"] == tid
+            assert all(s["trace_id"] == tid for s in stitched["spans"])
+            # one tree per client request: the gateway hop roots it,
+            # the replica-process hop nests under it
+            assert len(stitched["tree"]) == 1
+            root = stitched["tree"][0]
+            assert root["source"] == "gateway"
+            assert root["name"] == "serving.fleet.request"
+
+            def sources(node):
+                yield node["source"]
+                for c in node["children"]:
+                    yield from sources(c)
+
+            srcs = set(sources(root))
+            assert len(srcs) >= 2, f"trace {tid} never left the gateway"
+            replica_sources |= (srcs - {"gateway"})
+        # concurrent traffic spread across the pool: spans stitched
+        # from >= 2 distinct replica processes (3 stores incl. gateway)
+        assert len(replica_sources) >= 2
+
+    def test_fleet_alerts_endpoint_reports_slo_states(self, fleet_gw):
+        _wave(fleet_gw, range(4))
+        r = _get(f"{fleet_gw.url.rsplit('/', 1)[0]}/fleet/alerts")
+        assert r.status_code == 200
+        alerts = {a["slo"]: a for a in r.json()["alerts"]}
+        assert set(alerts) == {"availability", "latency_p99",
+                               "deadline_miss"}
+        for a in alerts.values():
+            assert a["state"] in ("inactive", "pending", "firing",
+                                  "resolved")
+            assert "burn_fast" in a and "burn_slow" in a
+        # an all-healthy pool burns no availability budget
+        assert alerts["availability"]["state"] == "inactive"

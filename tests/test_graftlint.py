@@ -442,6 +442,49 @@ class TestG3Registries:
         found = g3.collision_findings({"a.b", "a_b"})
         assert _rules(found) == ["M002"]
 
+    @staticmethod
+    def _metrics_root(tmp_path, body: str) -> str:
+        pkg = tmp_path / "mmlspark_tpu" / "core" / "telemetry"
+        pkg.mkdir(parents=True)
+        (pkg / "metrics.py").write_text(body)
+        return str(tmp_path)
+
+    def test_m003_unpinned_histogram(self, tmp_path):
+        root = self._metrics_root(tmp_path, (
+            'DECLARED_METRICS = {"a.latency": "histogram",\n'
+            '                    "a.count": "counter"}\n'
+            'BUCKET_FAMILIES = {"latency": (1.0,)}\n'
+            'HISTOGRAM_FAMILY = {}\n'))
+        found = g3.bucket_family_findings(root)
+        assert _rules(found) == ["M003"]
+        assert "a.latency" in found[0].message
+        assert "not pinned" in found[0].message
+
+    def test_m003_unknown_family_and_stale_mapping(self, tmp_path):
+        root = self._metrics_root(tmp_path, (
+            'DECLARED_METRICS = {"a.latency": "histogram"}\n'
+            'BUCKET_FAMILIES = {"latency": (1.0,)}\n'
+            'HISTOGRAM_FAMILY = {"a.latency": "nope",\n'
+            '                    "gone.hist": "latency"}\n'))
+        found = g3.bucket_family_findings(root)
+        assert _rules(found) == ["M003", "M003"]
+        msgs = " / ".join(f.message for f in found)
+        assert "unknown bucket family 'nope'" in msgs
+        assert "gone.hist" in msgs
+
+    def test_m003_pinned_histograms_are_clean(self, tmp_path):
+        root = self._metrics_root(tmp_path, (
+            'DECLARED_METRICS = {"a.latency": "histogram",\n'
+            '                    "a.count": "counter"}\n'
+            'BUCKET_FAMILIES = {"latency": (1.0,)}\n'
+            'HISTOGRAM_FAMILY = {"a.latency": "latency"}\n'))
+        assert g3.bucket_family_findings(root) == []
+
+    def test_m003_real_tree_tables_are_complete(self):
+        # the shipped metrics.py must keep every declared histogram on a
+        # named family — this is the invariant the fleet merger rides on
+        assert g3.bucket_family_findings(ROOT) == []
+
     def test_span_naming(self):
         sf = _sf('from ..core.telemetry import span\n'
                  'with span("oneword"):\n    pass\n'

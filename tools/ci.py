@@ -10,6 +10,9 @@ anywhere:
     python tools/ci.py perf-gate --fresh /tmp/bench_obs.json
                                             # bench regression gate
     python tools/ci.py fleet-smoke          # gateway kill/revive soak
+    python tools/ci.py obs-soak             # telemetry plane: kill ->
+                                            # alert -> autoscale ->
+                                            # incident -> resolve
     python tools/ci.py flow-soak            # graftflow runtime chaos soak
     python tools/ci.py feed-bench           # 3-path h2d transfer smoke
     python tools/ci.py sanitize [--json]    # all soaks under GRAFTSAN=1
@@ -280,6 +283,25 @@ def fleet_smoke(timeout_s: int = 300) -> int:
     return rc
 
 
+def obs_soak(timeout_s: int = 300) -> int:
+    """Run the observability-plane soak (tools/fleet_soak.py --obs):
+    kill a replica mid-traffic, assert the availability SLO alert fires
+    within one fast burn window, the AutoscaleController provisions a
+    replacement, the flight recorder dumps an incident bundle, and the
+    alert resolves — under the fleet exactly-once audit.  CPU backend so
+    the job runs on any CI machine."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join("tools", "fleet_soak.py"),
+           "--obs", "--json"]
+    try:
+        rc = subprocess.call(cmd, cwd=ROOT, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"obs-soak timed out after {timeout_s}s")
+        return 1
+    print("obs-soak:", "OK" if rc == 0 else f"FAILED (rc={rc})")
+    return rc
+
+
 def train_smoke(timeout_s: int = 300) -> int:
     """Run the training-reliability soak (tools/train_soak.py) as a
     smoke job: seeded NaN batches + mid-epoch kill + on-disk checkpoint
@@ -349,6 +371,7 @@ def sanitize(timeout_s: int = 300, json_out: bool = False) -> int:
         ("chaos-gateway", [os.path.join("tools", "chaos_soak.py"),
                            "--gateway"]),
         ("fleet", [os.path.join("tools", "fleet_soak.py")]),
+        ("obs", [os.path.join("tools", "fleet_soak.py"), "--obs"]),
         ("train", [os.path.join("tools", "train_soak.py")]),
     ]
     failures = 0
@@ -373,8 +396,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("command", choices=["lint", "metrics-lint", "test",
                                         "perf-gate", "fleet-smoke",
-                                        "train-soak", "flow-soak",
-                                        "feed-bench", "sanitize", "all"])
+                                        "obs-soak", "train-soak",
+                                        "flow-soak", "feed-bench",
+                                        "sanitize", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
@@ -402,6 +426,8 @@ def main(argv=None):
         return perf_gate(args.fresh, args.against, args.scale)
     if args.command == "fleet-smoke":
         return fleet_smoke()
+    if args.command == "obs-soak":
+        return obs_soak()
     if args.command == "train-soak":
         return train_smoke()
     if args.command == "flow-soak":

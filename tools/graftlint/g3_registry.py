@@ -15,6 +15,14 @@ violate at runtime:
   grep habits survive): instrumented literals must resolve against the
   declared table, and no two declared names may sanitize to the same
   Prometheus name.
+* **M003 — histogram bucket families.**  Every DECLARED_METRICS
+  histogram must be pinned to a named bucket family
+  (``HISTOGRAM_FAMILY`` → ``BUCKET_FAMILIES`` in metrics.py:
+  latency/bytes/fill).  The fleet telemetry plane
+  (core/telemetry/fleet.py) merges replica histograms bucket-by-bucket,
+  which is exact only when every process shares identical ``le``
+  edges — a histogram outside a family is one bucket-ladder drift away
+  from a silently-wrong merged p99.
 * **G303 — span naming.**  ``span()``/``record_span()`` literals must
   follow the ``layer.component[.detail]`` lowercase dotted convention
   (docs/observability.md); a one-word span name is unfindable next to
@@ -45,9 +53,10 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from .core import Finding, SourceFile
 
 __all__ = ["check_registries", "declared_metric_names",
+           "declared_metric_kinds", "histogram_family_tables",
            "sanitize_metric_name", "metric_findings",
-           "collision_findings", "fault_point_sites",
-           "documented_fault_points"]
+           "collision_findings", "bucket_family_findings",
+           "fault_point_sites", "documented_fault_points"]
 
 # -------------------------------------------------- fault-point registry
 
@@ -142,9 +151,9 @@ _TELEMETRY_IMPORT = re.compile(
 _TELEMETRY_PKG = "mmlspark_tpu/core/telemetry"
 
 
-def declared_metric_names(root: str) -> Set[str]:
-    """DECLARED_METRICS keys parsed out of metrics.py's dict literal via
-    AST — importing mmlspark_tpu here would pull jax into every lint."""
+def _metrics_dict_literal(root: str, var: str) -> Optional[ast.Dict]:
+    """The ``var = {...}`` dict literal in metrics.py, via AST —
+    importing mmlspark_tpu here would pull jax into every lint."""
     path = os.path.join(root, "mmlspark_tpu", "core", "telemetry",
                         "metrics.py")
     with open(path, encoding="utf-8") as f:
@@ -152,16 +161,59 @@ def declared_metric_names(root: str) -> Set[str]:
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             targets = node.targets
-        elif isinstance(node, ast.AnnAssign):  # DECLARED_METRICS: Dict = {}
+        elif isinstance(node, ast.AnnAssign):  # VAR: Dict[...] = {}
             targets = [node.target]
         else:
             continue
-        if (any(isinstance(t, ast.Name) and t.id == "DECLARED_METRICS"
-                for t in targets)
+        if (any(isinstance(t, ast.Name) and t.id == var for t in targets)
                 and isinstance(node.value, ast.Dict)):
-            return {k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)}
-    raise RuntimeError(f"DECLARED_METRICS dict literal not found in {path}")
+            return node.value
+    return None
+
+
+def declared_metric_names(root: str) -> Set[str]:
+    """DECLARED_METRICS keys parsed out of metrics.py's dict literal."""
+    lit = _metrics_dict_literal(root, "DECLARED_METRICS")
+    if lit is None:
+        raise RuntimeError("DECLARED_METRICS dict literal not found in "
+                           "metrics.py")
+    return {k.value for k in lit.keys if isinstance(k, ast.Constant)}
+
+
+def declared_metric_kinds(root: str) -> Dict[str, str]:
+    """DECLARED_METRICS as name -> kind ('counter'/'gauge'/'histogram'),
+    keeping only entries whose key AND value are string constants."""
+    lit = _metrics_dict_literal(root, "DECLARED_METRICS")
+    if lit is None:
+        raise RuntimeError("DECLARED_METRICS dict literal not found in "
+                           "metrics.py")
+    out: Dict[str, str] = {}
+    for k, v in zip(lit.keys, lit.values):
+        if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)):
+            out[k.value] = v.value
+    return out
+
+
+def histogram_family_tables(root: str) -> Tuple[Set[str], Dict[str, str]]:
+    """(BUCKET_FAMILIES keys, HISTOGRAM_FAMILY name->family) parsed from
+    metrics.py.  HISTOGRAM_FAMILY values must be string constants; the
+    family ladders themselves (tuple expressions) are runtime-checked by
+    MetricsRegistry.histogram, not re-evaluated here."""
+    fam_lit = _metrics_dict_literal(root, "BUCKET_FAMILIES")
+    map_lit = _metrics_dict_literal(root, "HISTOGRAM_FAMILY")
+    families = ({k.value for k in fam_lit.keys
+                 if isinstance(k, ast.Constant)}
+                if fam_lit is not None else set())
+    mapping: Dict[str, str] = {}
+    if map_lit is not None:
+        for k, v in zip(map_lit.keys, map_lit.values):
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                mapping[k.value] = v.value
+    return families, mapping
 
 
 # Prometheus-name sanitization, kept in lockstep with
@@ -192,6 +244,48 @@ def collision_findings(declared: Set[str]) -> List[Finding]:
                 hint="rename one so the scraped series stay distinct"))
         else:
             by_prom[pn] = name
+    return findings
+
+
+def bucket_family_findings(root: str) -> List[Finding]:
+    """M003: every declared histogram must be pinned to a named bucket
+    family so the fleet merger (core/telemetry/fleet.py) always sees
+    identical ``le`` edges across replicas."""
+    findings: List[Finding] = []
+    metrics_rel = f"{_TELEMETRY_PKG}/metrics.py"
+    try:
+        kinds = declared_metric_kinds(root)
+        families, mapping = histogram_family_tables(root)
+    except (OSError, RuntimeError, SyntaxError) as e:
+        return [Finding(
+            rule="M003", path=metrics_rel, line=0, symbol="metrics.py",
+            message=f"could not parse bucket-family tables: {e}",
+            hint="keep DECLARED_METRICS / BUCKET_FAMILIES / "
+                 "HISTOGRAM_FAMILY plain dict literals")]
+    hists = sorted(n for n, k in kinds.items() if k == "histogram")
+    for name in hists:
+        fam = mapping.get(name)
+        if fam is None:
+            findings.append(Finding(
+                rule="M003", path=metrics_rel, line=0, symbol=name,
+                message=f"declared histogram {name!r} is not pinned to a "
+                        f"bucket family in HISTOGRAM_FAMILY",
+                hint="map it to one of "
+                     + "/".join(sorted(families))
+                     + " so cross-replica merges stay exact"))
+        elif fam not in families:
+            findings.append(Finding(
+                rule="M003", path=metrics_rel, line=0, symbol=name,
+                message=f"histogram {name!r} maps to unknown bucket "
+                        f"family {fam!r}",
+                hint="families are the BUCKET_FAMILIES keys: "
+                     + "/".join(sorted(families))))
+    for name in sorted(set(mapping) - set(hists)):
+        findings.append(Finding(
+            rule="M003", path=metrics_rel, line=0, symbol=name,
+            message=f"HISTOGRAM_FAMILY entry {name!r} is not a declared "
+                    f"histogram in DECLARED_METRICS",
+            hint="prune the stale mapping (or declare the histogram)"))
     return findings
 
 
@@ -385,6 +479,7 @@ def check_registries(files: Sequence[SourceFile], root: str
     declared = declared_metric_names(root)
     findings = _fault_registry_findings(files, root)
     findings += collision_findings(declared)
+    findings += bucket_family_findings(root)
     findings += metric_findings(files, declared)
     findings += _span_findings(files)
     findings += _queue_telemetry_findings(files)

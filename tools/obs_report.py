@@ -11,9 +11,16 @@ Usage:
     python tools/obs_report.py SNAPSHOT.json --chrome-out TRACE.json
                                         # Perfetto/chrome://tracing dump
     python tools/obs_report.py --demo   # tiny in-process serving round-trip
+    python tools/obs_report.py --fleet http://HOST:PORT [--trace ID]
+                                        # live gateway: merged fleet table,
+                                        # alerts, stitched cross-replica tree
+    python tools/obs_report.py --incident incidents/<ts>-<reason>/
+                                        # pretty-print a flight-recorder
+                                        # bundle (docs/observability.md)
 
-Also importable (tests/test_observability.py): `render_report(snapshot)`
-returns the full text.
+Also importable (tests/test_observability.py, tests/test_fleet_obs.py):
+`render_report(snapshot)` / `render_fleet_report(merged)` /
+`render_incident(bundle_dir)` return the full text.
 """
 from __future__ import annotations
 
@@ -93,6 +100,144 @@ def render_report(snapshot: Dict[str, Any], trace_id: Optional[str] = None,
     return "\n".join(lines)
 
 
+def render_fleet_report(merged: Dict[str, Any],
+                        alerts: Optional[List[Dict[str, Any]]] = None,
+                        traces: Optional[Dict[str, Any]] = None) -> str:
+    """The merged fleet view (core.telemetry.fleet.merge_snapshots shape)
+    as one human-readable page: replica roster, exact-merged latency
+    table, fleet counters, per-replica gauges, alert states, and any
+    stitched cross-replica span trees."""
+    from mmlspark_tpu.core.telemetry import (format_latency_table,
+                                             format_span_tree)
+
+    lines: List[str] = []
+    meta = merged.get("meta") or {}
+    lines.append("== fleet ==")
+    lines.append(f"  replicas = {meta.get('replica_count', '?')} "
+                 f"({', '.join(meta.get('sources') or [])})")
+    for k in sorted(set(meta) - {"replica_count", "sources"}):
+        lines.append(f"  {k} = {meta[k]}")
+    roster = merged.get("replicas") or {}
+    for rkey in sorted(roster):
+        ver = roster[rkey].get("version")
+        lines.append(f"  {rkey}: version={ver if ver else '-'}")
+    lines.append("")
+    hists = merged.get("histograms") or {}
+    if hists:
+        lines.append("== fleet latency table (exact bucket-wise merge) ==")
+        lines.append(format_latency_table(hists))
+        lines.append("")
+    counters = merged.get("counters") or {}
+    if counters:
+        by = merged.get("counters_by_replica") or {}
+        lines.append("== fleet counters (summed; per-replica split) ==")
+        for k in sorted(counters):
+            split = ", ".join(f"{r}={by[r][k]}" for r in sorted(by)
+                              if k in by[r])
+            lines.append(f"  {k} = {counters[k]}  [{split}]")
+        lines.append("")
+    gauges = merged.get("gauges") or {}
+    if gauges:
+        lines.append("== gauges (per replica) ==")
+        for k in sorted(gauges):
+            split = ", ".join(f"{r}={gauges[k][r]:g}"
+                              for r in sorted(gauges[k]))
+            lines.append(f"  {k}: {split}")
+        lines.append("")
+    if alerts:
+        lines.append("== slo alerts ==")
+        for a in alerts:
+            lines.append(
+                f"  {a.get('slo')}: {a.get('state')}  "
+                f"burn_fast={a.get('burn_fast')} "
+                f"burn_slow={a.get('burn_slow')} "
+                f"(threshold {a.get('burn_threshold')}, "
+                f"objective {a.get('objective')})")
+        lines.append("")
+    for tid, stitched in sorted((traces or {}).items()):
+        srcs = ", ".join(stitched.get("sources") or [])
+        lines.append(f"== stitched trace {tid} "
+                     f"({stitched.get('span_count', 0)} spans from "
+                     f"{srcs}) ==")
+        tree = stitched.get("tree") or []
+        lines.append(format_span_tree(tree) if tree else "  (no spans)")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def render_incident(bundle_dir: str) -> str:
+    """Pretty-print one flight-recorder bundle
+    (``incidents/<ts>-<seq>-<reason>/``, see docs/observability.md)."""
+    bundle = Path(bundle_dir)
+
+    def _load(name: str) -> Any:
+        p = bundle / name
+        if not p.exists():
+            return None
+        return json.loads(p.read_text())
+
+    manifest = _load("MANIFEST.json") or {}
+    lines: List[str] = []
+    lines.append(f"== incident {bundle.name} ==")
+    lines.append(f"  reason  = {manifest.get('reason', '?')}")
+    lines.append(f"  created = {manifest.get('created', '?')}")
+    lines.append(f"  files   = {', '.join(manifest.get('files') or [])}")
+    lines.append("")
+    alerts = _load("alerts.json")
+    merged = _load("snapshot.json")
+    traces = _load("traces.json")
+    if merged is not None:
+        lines.append(render_fleet_report(merged, alerts=alerts,
+                                         traces=traces))
+    elif alerts:
+        for a in alerts:
+            lines.append(f"  alert {a.get('slo')}: {a.get('state')}")
+        lines.append("")
+    health = _load("health.json")
+    if health is not None:
+        lines.append("== gateway health at dump ==")
+        for rep in health.get("replicas") or []:
+            lines.append(
+                f"  {rep.get('key') or rep.get('url')}: "
+                f"healthy={rep.get('healthy')} "
+                f"draining={rep.get('draining')} "
+                f"breaker={rep.get('breaker')} "
+                f"version={rep.get('version')}")
+        lines.append("")
+    records = _load("records.json")
+    if records:
+        lines.append(f"== last {len(records)} request records ==")
+        errs = [r for r in records if r.get("error")]
+        lines.append(f"  errors = {len(errs)}")
+        for r in records[-5:]:
+            lines.append(f"  {r.get('name')} wall_s={r.get('wall_s')} "
+                         f"trace={r.get('trace_id')}"
+                         + (f" !{r['error']}" if r.get("error") else ""))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _fetch_json(url: str) -> Any:
+    from mmlspark_tpu.io.http.clients import send_request
+    from mmlspark_tpu.io.http.schema import HTTPRequestData
+
+    resp = send_request(HTTPRequestData(url=url, method="GET"),
+                        timeout=10.0)
+    if not resp.ok:
+        raise SystemExit(f"GET {url} -> {resp.status_code}")
+    return resp.json()
+
+
+def _fleet_report(gateway_url: str, trace_id: Optional[str]) -> str:
+    base = gateway_url.rstrip("/")
+    merged = _fetch_json(base + "/fleet/metrics.json")
+    alerts = (_fetch_json(base + "/fleet/alerts") or {}).get("alerts")
+    traces = None
+    if trace_id:
+        traces = {trace_id: _fetch_json(f"{base}/trace/{trace_id}")}
+    return render_fleet_report(merged, alerts=alerts, traces=traces)
+
+
 def _demo_snapshot() -> Dict[str, Any]:
     """A real serving round-trip on this host (CPU devices are fine):
     identity-ish model behind ServingServer, a few traced requests, then
@@ -141,7 +286,19 @@ def main(argv=None) -> int:
     ap.add_argument("--chrome-out", default=None, metavar="FILE",
                     help="also write the snapshot's spans as "
                          "Chrome/Perfetto trace-event JSON")
+    ap.add_argument("--fleet", default=None, metavar="GATEWAY_URL",
+                    help="scrape a live FleetGateway's /fleet/* endpoints "
+                         "and render the merged fleet report")
+    ap.add_argument("--incident", default=None, metavar="DIR",
+                    help="pretty-print one flight-recorder bundle "
+                         "(incidents/<ts>-<reason>/)")
     args = ap.parse_args(argv)
+    if args.fleet:
+        print(_fleet_report(args.fleet, args.trace))
+        return 0
+    if args.incident:
+        print(render_incident(args.incident))
+        return 0
     if args.demo:
         snapshot = _demo_snapshot()
     elif args.snapshot is not None:

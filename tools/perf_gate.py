@@ -68,6 +68,11 @@ GATE_METRICS: Dict[str, Tuple[str, float, float]] = {
     # None` branches + make_lock indirection cost <1% per item, absolute
     # band for the same near-zero-base reason
     "sanitizer_overhead_frac": ("lower", 0.0, 0.01),
+    # one federated pull over the 8-replica bench pool (PR 15): HTTP
+    # fan-out + exact merge + SLO eval, off the gateway routing lock.
+    # Host-side HTTP timings swing with machine load (50%), with an
+    # absolute floor so a near-zero base doesn't trip on scheduler dust
+    "fleet_scrape_ms": ("lower", 0.50, 5.0),
 }
 
 
