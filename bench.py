@@ -484,6 +484,106 @@ def _measure_transformer(batch: int = 16, seq: int = 1024,
     }
 
 
+LM3D_LAYOUTS = (((8, 1, 1), (2, 1)), ((2, 4, 1), (2, 2)),
+                ((2, 2, 2), (2, 2)))  # ((D, T, P), (accum, microbatches))
+
+
+def _lm3d_child():
+    """Runs in its own subprocess with JAX_PLATFORMS=cpu and an 8-device
+    virtual mesh (the env is set by the PARENT before this process
+    imports jax — host_platform_device_count binds at import).  Sweeps
+    the (D, T, P) layouts of the 3D-mesh GSPMD trainer and prints one
+    JSON line; the remat saving is read off XLA's own memory analysis of
+    the same program compiled both ways."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.models.training import (lm_params_to_3d,
+                                              make_lm_train_step_3d,
+                                              shard_params)
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.parallel.mesh import MeshPlan
+    from mmlspark_tpu.parallel.sharding_rules import lm_3d_rules
+
+    V, E, L, H, S = 2048, 256, 4, 8, 256
+    model = transformer_lm(vocab_size=V, embed_dim=E, num_layers=L,
+                           num_heads=H, max_len=S, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (16, S), 0, V,
+                              jnp.int32)
+    params = jax.jit(lambda r, t: model.init(r, t)["params"])(rng, toks[:2])
+    opt = optax.adam(3e-4)
+
+    out = {"lm3d_layouts": {}, "grad_accum_steps": None}
+    flops_step = 0.0
+    best_ms = None
+    for (d, t, p), (a, m) in LM3D_LAYOUTS:
+        plan = MeshPlan(data=d, model=t, pipe=p)
+        p3 = shard_params(lm_params_to_3d(params, L, p), plan.mesh,
+                          lm_3d_rules())
+        os3 = opt.init(p3)
+        step = make_lm_train_step_3d(model, opt, plan, remat=True,
+                                     donate=False)
+        tb = toks.reshape(a, m, 16 // (a * m), S)
+        lowered = step.lower(p3, os3, tb)
+        if not flops_step:
+            try:
+                cost = lowered.cost_analysis()
+                flops_step = float(cost.get("flops", 0.0)) if cost else 0.0
+            except Exception:  # noqa: BLE001
+                flops_step = 0.0
+        compiled = lowered.compile()
+        ms = _best_of(lambda: compiled(p3, os3, tb)[2]["loss"],
+                      iters=1) * 1e3
+        out["lm3d_layouts"][f"{d}x{t}x{p}"] = round(ms, 2)
+        out["grad_accum_steps"] = a
+        if best_ms is None or ms < best_ms:
+            best_ms = ms
+    out["lm3d_step_ms"] = round(best_ms, 2)
+    peak = _chip_peak_flops()
+    out["lm_train_mfu_3d"] = (round(flops_step / (best_ms / 1e3) / peak, 4)
+                              if peak and flops_step else None)
+
+    # remat saving at the full-3D layout: identical program, one compile
+    # with block remat and one without — the delta is the activation
+    # memory the dots-saveable policy trades for recompute
+    plan = MeshPlan(data=2, model=2, pipe=2)
+    p3 = shard_params(lm_params_to_3d(params, L, 2), plan.mesh,
+                      lm_3d_rules())
+    os3 = opt.init(p3)
+    tb = toks.reshape(2, 2, 4, S)
+    mems = {}
+    for remat in (False, True):
+        step = make_lm_train_step_3d(model, opt, plan, remat=remat,
+                                     donate=False)
+        try:
+            ma = step.lower(p3, os3, tb).compile().memory_analysis()
+            mems[remat] = int(getattr(ma, "temp_size_in_bytes", 0))
+        except Exception:  # noqa: BLE001
+            mems[remat] = 0
+    if mems.get(False) and mems.get(True):
+        out["remat_hbm_saved_bytes"] = mems[False] - mems[True]
+    print(json.dumps(out))
+
+
+def _measure_lm_3d(timeout: int = 900) -> dict:
+    """Parent-side wrapper: the sweep ALWAYS runs on the 8-device virtual
+    CPU mesh (layout comparison needs 8 homogeneous devices; a 1-chip
+    tunnel box has one) — a fresh subprocess gets the forced env because
+    device count binds at jax import."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          ).strip())
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--lm3d-child"],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    if proc.returncode != 0 or not proc.stdout.strip():
+        return {"lm3d_error": (proc.stderr or "no output")[-200:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def _measure_vit(batch: int = 128, iters: int = 10) -> dict:
     """ViT-B/16 bf16 inference MFU — the matmul-dominated vision backbone.
     ResNet-50's roofline caps near 0.47 MFU on a v5e (docs/performance.md);
@@ -772,6 +872,10 @@ def _child_measure():
             except Exception as e2:  # noqa: BLE001
                 lm = {"lm_error": f"{str(e)[-120:]} | retry: {str(e2)[-120:]}"}
     try:
+        lm3d = _measure_lm_3d()
+    except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
+        lm3d = {"lm3d_error": str(e)[-200:]}
+    try:
         guard = _measure_guard()
     except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
         guard = {"guard_error": str(e)[-200:]}
@@ -791,8 +895,8 @@ def _child_measure():
         include_spans=False,
         timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm,
-                      "guard": guard, "san": san, "fleet": fleet,
-                      "obs": obs}))
+                      "lm3d": lm3d, "guard": guard, "san": san,
+                      "fleet": fleet, "obs": obs}))
 
 
 def _obs_out_path():
@@ -819,6 +923,14 @@ def main():
     obs_path = _obs_out_path()
     if "--child-measure" in sys.argv:
         _child_measure()
+        return
+    if "--lm3d-child" in sys.argv:
+        _lm3d_child()
+        return
+    if "--lm3d" in sys.argv:
+        # standalone sweep entry (CI / local): no chip probe needed —
+        # the sweep is defined on the virtual CPU mesh
+        print(json.dumps(_measure_lm_3d()))
         return
     if "--measure-cpu" in sys.argv:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -931,6 +1043,8 @@ def main():
            and "train_error" in train else {}),
         **{k: v for k, v in child.get("vit", {}).items() if v is not None},
         **{k: v for k, v in child.get("lm", {}).items() if v is not None},
+        **{k: v for k, v in child.get("lm3d", {}).items()
+           if v is not None},
         **{k: v for k, v in child.get("guard", {}).items()
            if v is not None},
         **{k: v for k, v in child.get("san", {}).items()

@@ -65,6 +65,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "training.hang": "counter",
     "checkpoint.corrupt": "counter",
     "checkpoint.fallback": "counter",
+    "checkpoint.quarantine": "counter",
     "checkpoint.write_failed": "counter",
     "io.pipeline.items": "counter",       # + .<stage> variants
     # -- counters: the graftflow runtime ledger (core/flow.py, PR 12)

@@ -18,6 +18,21 @@ the bytes it resumes from:
   one, never a torn one.  A manifest that *exists but does not parse* is a
   torn write from a dying filesystem: the checkpoint is treated as absent
   (and counted ``checkpoint.corrupt``).
+* when the state being saved is SHARDED (the 3D-mesh trainer), each
+  sharded leaf's manifest entry additionally carries its PartitionSpec
+  string and a crc32 **per shard** (format 2), keyed by shard index and
+  the shard's slice bounds within the global array.  Verification
+  re-slices the restored global array by those bounds, so a flipped byte
+  in any single shard's bytes is pinned to the exact (leaf, spec, shard)
+  that rotted — and one bad shard fails the whole step, never a partial
+  accept.
+* ``quarantine_step()`` moves a corrupt step directory aside into
+  ``<dir>/quarantined/`` (counted ``checkpoint.quarantine``) instead of
+  deleting it, preserving the evidence for post-mortem while taking the
+  step out of the restore walk; ``restore_verified(quarantine=True)``
+  does this automatically for every corrupt step it walks past, and its
+  ``on_corrupt`` hook lets the TrainingGuard record the quarantined path
+  in its own ledger.
 * ``restore()`` re-hashes every leaf and compares against the manifest
   (``checkpoint.verify.latency`` histogram); a mismatch raises
   :class:`CheckpointCorruptError` and counts ``checkpoint.corrupt``.
@@ -77,11 +92,56 @@ def _leaf_digests(payload) -> Dict[str, Dict]:
     return out
 
 
+def _shard_bounds(index, shape) -> List[List[int]]:
+    """A shard's index (tuple of slices into the global array) as JSON
+    [[start, stop], ...] bounds, slice defaults resolved against the
+    global shape."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _shard_digests(payload) -> Dict[str, Dict]:
+    """Per-SHARD crc32 entries for every live sharded jax.Array leaf
+    (keyed like :func:`_leaf_digests`): ``{"spec": str(PartitionSpec),
+    "shards": [{"i", "index": bounds, "crc32"}]}``.  Replicated copies
+    dedupe by their slice bounds — D-way replication must not turn one
+    logical shard into D manifest rows.  Host-numpy / single-shard
+    leaves contribute nothing (the whole-leaf crc already covers them)."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(payload)
+    out = {}
+    for path, leaf in leaves:
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None or not getattr(leaf, "shape", ()):
+            continue
+        seen = {}
+        for sh in shards:
+            bounds = tuple(map(tuple, _shard_bounds(sh.index, leaf.shape)))
+            if bounds in seen:
+                continue
+            seen[bounds] = zlib.crc32(np.ascontiguousarray(
+                np.asarray(sh.data)).tobytes())
+        if len(seen) <= 1:
+            continue
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        out[jax.tree_util.keystr(path)] = {
+            "spec": str(spec),
+            "shards": [{"i": i, "index": [list(b) for b in bounds],
+                        "crc32": crc}
+                       for i, (bounds, crc) in enumerate(
+                           sorted(seen.items()))],
+        }
+    return out
+
+
 def _write_manifest(step_dir: str, mgr_step: int, state_step: int,
                     digests: Dict[str, Dict]) -> None:
     """Atomic manifest write: tmp + fsync + rename (+ directory fsync so
     the rename itself survives power loss)."""
-    doc = {"format": 1, "step": int(mgr_step), "state_step": int(state_step),
+    doc = {"format": 2, "step": int(mgr_step), "state_step": int(state_step),
            "leaves": digests}
     path = os.path.join(step_dir, MANIFEST_NAME)
     tmp = path + ".tmp"
@@ -138,9 +198,15 @@ class CheckpointManager:
             # written after the orbax write completes; async saves
             # (wait=False) stay manifest-less and restore as legacy
             self._mgr.wait_until_finished()
+            # per-shard digests come off the LIVE (possibly sharded)
+            # arrays before the host gather erases the shard structure
+            shard_info = _shard_digests(payload)
             host = jax.tree.map(lambda x: np.asarray(x), payload)
+            digests = _leaf_digests(host)
+            for key, entry in shard_info.items():
+                digests[key].update(entry)
             _write_manifest(self._step_dir(mgr_step), mgr_step,
-                            int(state.step), _leaf_digests(host))
+                            int(state.step), digests)
         return mgr_step
 
     def latest_step(self) -> Optional[int]:
@@ -153,6 +219,33 @@ class CheckpointManager:
         """Drop one step (checkpoint + manifest) — used when a rollback
         replay re-saves a schedule position it already passed."""
         self._mgr.delete(int(step))
+
+    def quarantine_step(self, step: int) -> str:
+        """Move a corrupt step's directory aside into
+        ``<dir>/quarantined/<step>`` instead of deleting it: the restore
+        walk stops seeing it (orbax only parses integer-named step dirs),
+        but the bytes survive for post-mortem.  Returns the quarantine
+        path; counts ``checkpoint.quarantine``."""
+        step = int(step)
+        src = self._step_dir(step)
+        qdir = os.path.join(self.directory, "quarantined")
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, str(step))
+        if os.path.exists(dst):
+            # a second corruption of the same schedule position (rollback
+            # replay re-saved it) must not clobber the first exhibit
+            n = 1
+            while os.path.exists(f"{dst}.{n}"):
+                n += 1
+            dst = f"{dst}.{n}"
+        os.replace(src, dst)
+        core_telemetry.incr("checkpoint.quarantine")
+        # drop the manager's cached view of the moved step
+        try:
+            self._mgr.reload()
+        except Exception:
+            pass
+        return dst
 
     # ------------------------------------------------------ integrity
 
@@ -189,12 +282,33 @@ class CheckpointManager:
                if actual.get(k, {}).get("crc32") != expect[k]["crc32"]]
         missing = [k for k in expect if k not in actual]
         extra = [k for k in actual if k not in expect]
-        if bad or missing or extra:
+        # per-shard verification (format 2): re-slice the restored global
+        # array by each shard's saved bounds — pins corruption to the
+        # exact (leaf, spec, shard) instead of "some leaf changed"
+        host = {path: leaf for path, leaf in
+                ((jax.tree_util.keystr(p), l) for p, l in
+                 jax.tree_util.tree_flatten_with_path(payload)[0])}
+        bad_shards = []
+        for k, entry in expect.items():
+            if "shards" not in entry or k not in host:
+                continue
+            arr = np.asarray(host[k])
+            for sh in entry["shards"]:
+                sl = tuple(slice(a, b) for a, b in sh["index"])
+                crc = zlib.crc32(np.ascontiguousarray(arr[sl]).tobytes())
+                if crc != sh["crc32"]:
+                    bad_shards.append(
+                        f"{k} spec={entry.get('spec')} shard={sh['i']} "
+                        f"bounds={sh['index']}")
+        if bad or missing or extra or bad_shards:
             core_telemetry.incr("checkpoint.corrupt")
+            detail = ("; corrupt shards: " + ", ".join(bad_shards)
+                      if bad_shards else "")
             raise CheckpointCorruptError(
                 f"checkpoint step {step} in {self.directory} failed "
                 f"verification: {len(bad)} leaf checksum mismatches, "
-                f"{len(missing)} missing, {len(extra)} unexpected")
+                f"{len(missing)} missing, {len(extra)} unexpected, "
+                f"{len(bad_shards)} shard mismatches{detail}")
 
     # -------------------------------------------------------- restore
 
@@ -239,29 +353,48 @@ class CheckpointManager:
             step=int(np.asarray(payload["step"])),
         )
 
-    def restore_verified(self, template: Optional[TrainState] = None):
+    def restore_verified(self, template: Optional[TrainState] = None,
+                         on_corrupt=None, quarantine: bool = False):
         """Self-healing restore: walk checkpoints newest-first and return
         ``(state, mgr_step)`` for the first that restores AND verifies.
         Every corrupt/unreadable step walked past counts
         ``checkpoint.fallback``; raises FileNotFoundError when no
         checkpoint survives (caller decides: fresh start or abort).
 
+        ``quarantine=True`` moves each corrupt step aside via
+        :meth:`quarantine_step`; ``on_corrupt(step, path)`` fires per
+        corrupt step with its (possibly quarantined) directory path —
+        the TrainingGuard records it in its own ledger there.
+
         Catches Exception only — an InjectedCrash (BaseException) still
         kills the process, as a real SIGKILL would."""
         steps = self.all_steps()
         if not steps:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
+
+        def _condemn(step):
+            path = self._step_dir(step)
+            if quarantine:
+                try:
+                    path = self.quarantine_step(step)
+                except OSError:
+                    pass  # already moved / vanished: the walk continues
+            if on_corrupt is not None:
+                on_corrupt(step, path)
+
         for step in reversed(steps):
             try:
                 return self.restore(step=step, template=template), step
             except CheckpointCorruptError:
                 # _read_manifest/_verify already counted checkpoint.corrupt
                 core_telemetry.incr("checkpoint.fallback")
+                _condemn(step)
             except Exception:
                 # orbax read errors, injected checkpoint.read faults: this
                 # step is not trustworthy either — keep walking back
                 core_telemetry.incr("checkpoint.corrupt")
                 core_telemetry.incr("checkpoint.fallback")
+                _condemn(step)
         raise FileNotFoundError(
             f"no checkpoint in {self.directory} passed verification "
             f"(tried {len(steps)} steps)")

@@ -123,6 +123,11 @@ class TrainingGuard:
         self._history: deque = deque(maxlen=self.window)
         self._spike_streak = 0
         self.quarantined: set = set()
+        # corrupt CHECKPOINT directories walked past on restore — a
+        # separate ledger from poisoned batches (different lifecycle:
+        # these are filesystem paths, recorded by restore_verified's
+        # on_corrupt hook, never re-admitted)
+        self.quarantined_checkpoints: set = set()
         self.rollbacks = 0
         self.lr_scale = 1.0
         self.anomalies: List[Dict] = []
@@ -292,13 +297,24 @@ class TrainingGuard:
 
     # ------------------------------------------------- quarantine I/O
 
+    def quarantine_checkpoint(self, step, path) -> None:
+        """Record a corrupt checkpoint the restore walk condemned (the
+        ``on_corrupt`` hook of ``restore_verified``): the (step, path)
+        pair lands in the persisted ledger so a post-mortem can find the
+        quarantined bytes even after further restarts."""
+        self.quarantined_checkpoints.add((int(step), str(path)))
+        self.anomalies.append({"kind": "checkpoint_corrupt",
+                               "step": int(step), "path": str(path)})
+
     def save_quarantine(self, path) -> None:
         """Atomically persist the quarantine set (tmp + fsync + rename:
         a crash mid-write leaves the previous file, never a torn one)."""
         path = os.fspath(path)
         ids = [list(b) if isinstance(b, tuple) else b
                for b in self.quarantined]
-        doc = {"quarantined": sorted(ids, key=repr)}
+        doc = {"quarantined": sorted(ids, key=repr),
+               "quarantined_checkpoints": sorted(
+                   [s, p] for s, p in self.quarantined_checkpoints)}
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
@@ -317,3 +333,11 @@ class TrainingGuard:
             return
         for b in ids:
             self.quarantined.add(tuple(b) if isinstance(b, list) else b)
+        # pre-format-2 quarantine.json has no checkpoint ledger: absent
+        # key is a legacy doc, not corruption
+        for entry in doc.get("quarantined_checkpoints", []):
+            try:
+                s, p = entry
+                self.quarantined_checkpoints.add((int(s), str(p)))
+            except (TypeError, ValueError):
+                continue

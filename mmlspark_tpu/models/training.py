@@ -21,9 +21,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import telemetry as core_telemetry
 from ..parallel.mesh import batch_sharding, default_mesh, replicated_sharding
+from ..parallel.sharding_rules import (make_shard_and_gather_fns,
+                                       match_partition_rules)
 
 __all__ = ["TrainState", "make_train_step", "make_train_epoch",
            "make_lm_train_epoch", "make_distill_epoch", "make_eval_step",
+           "make_lm_train_step_3d", "lm_params_to_3d", "lm_params_from_3d",
+           "make_lm_resumable_step_3d",
            "fit_epochs", "fit_epochs_resumable", "shard_params",
            "scan_slice_steps"]
 
@@ -61,18 +65,24 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def shard_params(tree, mesh: Mesh, model_axis_rules: Optional[Callable] = None):
+def shard_params(tree, mesh: Mesh, model_axis_rules=None):
     """Place a param tree on the mesh.  Default: replicate everything.
-    `model_axis_rules(path, arr) -> PartitionSpec` can shard big kernels over
-    'model' (tensor parallelism)."""
+
+    ``model_axis_rules`` is a partition-rule TABLE — an ordered sequence
+    of ``(regex, PartitionSpec)`` pairs matched first-wins against each
+    leaf's ``/``-joined path name (parallel/sharding_rules.py) — or,
+    legacy surface, a ``(path, arr) -> PartitionSpec`` callable."""
     if model_axis_rules is None:
         return jax.device_put(tree, replicated_sharding(mesh))
+    if callable(model_axis_rules):
+        def place(path, arr):
+            spec = model_axis_rules(path, arr) or P()
+            return jax.device_put(arr, NamedSharding(mesh, spec))
 
-    def place(path, arr):
-        spec = model_axis_rules(path, arr) or P()
-        return jax.device_put(arr, NamedSharding(mesh, spec))
-
-    return jax.tree_util.tree_map_with_path(place, tree)
+        return jax.tree_util.tree_map_with_path(place, tree)
+    specs = match_partition_rules(model_axis_rules, tree)
+    shard_fns, _ = make_shard_and_gather_fns(specs, mesh)
+    return jax.tree.map(lambda f, x: f(x), shard_fns, tree)
 
 
 def softmax_cross_entropy(logits, labels, num_classes):
@@ -235,6 +245,177 @@ def make_lm_train_epoch(
         in_shardings=(None, None, tok_sh),
         donate_argnums=(0, 1) if donate else (),
     ), name="training.lm_train_epoch")
+
+
+def lm_params_to_3d(params, num_layers: int, pipe: int):
+    """TransformerLM params -> the STACKED 3D-trainer layout:
+    ``{"embed": {tok_embed[, pos_embed]}, "blocks": <stacked>, "out":
+    {ln_f, head}}`` where every block leaf carries leading
+    [P_stages, K_blocks] dims (stage p owns blocks p*K .. p*K+K-1, the
+    contiguous split a pipe-sharded leading dim lays out for free).
+    Shard with ``shard_params(p3, plan.mesh, lm_3d_rules())``."""
+    if num_layers % pipe != 0:
+        raise ValueError(f"num_layers={num_layers} not divisible by "
+                         f"pipe={pipe}")
+    k = num_layers // pipe
+    blocks = [params[f"block{i}"] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    stacked = jax.tree.map(
+        lambda a: a.reshape((pipe, k) + a.shape[1:]), stacked)
+    embed = {n: params[n] for n in ("tok_embed", "pos_embed")
+             if n in params}
+    return {"embed": embed, "blocks": stacked,
+            "out": {"ln_f": params["ln_f"], "head": params["head"]}}
+
+
+def lm_params_from_3d(params3d, num_layers: int):
+    """Inverse of :func:`lm_params_to_3d` (back to the flax ``block{i}``
+    layout model.apply consumes — eval/generation/export)."""
+    flat = jax.tree.map(
+        lambda a: a.reshape((num_layers,) + a.shape[2:]),
+        params3d["blocks"])
+    params = {f"block{i}": jax.tree.map(lambda a, i=i: a[i], flat)
+              for i in range(num_layers)}
+    params.update(params3d["embed"])
+    params.update(params3d["out"])
+    return params
+
+
+def make_lm_train_step_3d(model, optimizer, plan, remat: bool = True,
+                          donate: bool = True):
+    """``step(params3d, opt_state, tokens) -> (params3d, opt_state,
+    metrics)`` on a :class:`~mmlspark_tpu.parallel.mesh.MeshPlan`'s 3D
+    mesh: data-parallel microbatches x megatron tensor rules x the GPipe
+    schedule (`parallel.pipeline.gpipe_spmd_apply`), in ONE jitted
+    program whose collectives XLA places from shardings.
+
+    ``tokens [A, M, mb, S]`` int32: A gradient-accumulation chunks of M
+    pipeline microbatches of mb sequences (mb sharded over 'data') —
+    global batch A*M*mb.  Accumulation is an outer `lax.scan` summing
+    grads across chunks before ONE optimizer update, so the HBM freed
+    by sharding + remat converts directly into batch size.  ``remat``
+    wraps each transformer block in `jax.checkpoint` with the
+    dots-saveable policy: matmul outputs are kept, everything else
+    (gelu, layernorm, attention softmax) recomputes in the backward —
+    the classic activation-memory / recompute trade.  Params/opt_state
+    are donated (the carry buffers die into their successors).
+
+    ``params3d`` is the :func:`lm_params_to_3d` layout, sharded via
+    ``shard_params(p3, plan.mesh, lm_3d_rules())``.  Loss is mean
+    next-token cross-entropy (equal-size microbatches, so the mean of
+    per-microbatch means equals the global mean and numerics match the
+    single-device reference).  MoE aux losses are NOT folded in on this
+    path yet.  Metrics carry loss + grad_norm — the TrainingGuard's
+    probe pair."""
+    import flax.linen as nn
+
+    from ..parallel.pipeline import gpipe_spmd_apply
+    from .transformer import _Block, default_attn
+
+    mesh = plan.mesh
+    if model.num_layers % plan.pipe != 0:
+        raise ValueError(f"num_layers={model.num_layers} not divisible "
+                         f"by pipe={plan.pipe}")
+    attn = (model.attn_fn if model.attn_fn is not None
+            else default_attn(True))
+    blk = _Block(model.num_heads, model.mlp_ratio, model.dtype, attn,
+                 dense_cls=model._dense_cls,
+                 num_experts=model.moe_experts,
+                 moe_capacity=model.moe_capacity,
+                 rope=model.pos_emb == "rope",
+                 kv_heads=model.num_kv_heads)
+    tok_embed = nn.Embed(model.vocab_size, model.embed_dim,
+                         dtype=model.dtype)
+    pos_embed = (nn.Embed(model.max_len, model.embed_dim,
+                          dtype=model.dtype)
+                 if model.pos_emb == "learned" else None)
+    ln_f = nn.LayerNorm(dtype=model.dtype)
+    head = model._dense_cls(model.vocab_size, use_bias=False,
+                            dtype=model.dtype)
+
+    def block_apply(pblk, h):
+        return blk.apply({"params": pblk}, h)
+
+    if remat:
+        block_apply = jax.checkpoint(
+            block_apply, policy=jax.checkpoint_policies.dots_saveable)
+
+    def stage_fn(pstage, h):
+        # pstage leaves [K, ...]: this stage's K consecutive blocks
+        h, _ = jax.lax.scan(
+            lambda c, pb: (block_apply(pb, c), None), h, pstage)
+        return h
+
+    def embed_one(p3, toks):
+        x = tok_embed.apply({"params": p3["embed"]["tok_embed"]}, toks)
+        if pos_embed is not None:
+            pe = pos_embed.apply({"params": p3["embed"]["pos_embed"]},
+                                 jnp.arange(toks.shape[-1]))
+            x = x + pe[None]
+        return x
+
+    def loss_of(p3, toks):
+        # toks [M, mb, S] -> mean next-token CE over all microbatches
+        xs = jax.vmap(lambda t: embed_one(p3, t))(toks)
+        hs = gpipe_spmd_apply(stage_fn, p3["blocks"], xs, mesh=mesh,
+                              axis="pipe", batch_axis="data")
+
+        def mb_loss(h, t):
+            h = ln_f.apply({"params": p3["out"]["ln_f"]}, h)
+            logits = head.apply({"params": p3["out"]["head"]}, h)
+            return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), t[:, 1:]))
+
+        return jnp.mean(jax.vmap(mb_loss)(hs, toks))
+
+    def step(params3d, opt_state, tokens):
+        def acc(carry, toks):
+            gsum, lsum = carry
+            loss, grads = jax.value_and_grad(loss_of)(params3d, toks)
+            return (jax.tree.map(jnp.add, gsum, grads),
+                    lsum + loss), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params3d)
+        (gsum, lsum), _ = jax.lax.scan(
+            acc, (zeros, jnp.zeros((), jnp.float32)), tokens)
+        a = jnp.float32(tokens.shape[0])
+        grads = jax.tree.map(lambda g: g / a, gsum)
+        updates, new_opt = optimizer.update(grads, opt_state, params3d)
+        new_params = optax.apply_updates(params3d, updates)
+        return new_params, new_opt, {
+            "loss": lsum / a, "grad_norm": optax.global_norm(grads)}
+
+    tok_sh = NamedSharding(mesh, P(None, None, "data", None))
+    return core_telemetry.watch_compiles(jax.jit(
+        step,
+        in_shardings=(None, None, tok_sh),
+        donate_argnums=(0, 1) if donate else (),
+    ), name="training.lm_train_step_3d")
+
+
+def make_lm_resumable_step_3d(model, optimizer, plan,
+                              microbatches: int, grad_accum: int = 1,
+                              remat: bool = True):
+    """Adapter threading the 3D step through :func:`fit_epochs_resumable`
+    (TrainState in/out, ``(state, tokens [B, S], labels-ignored)``
+    signature): the flat batch reshapes to the step's [A, M, mb, S]
+    accumulation layout.  B must equal A*M*mb for some mb."""
+    inner = make_lm_train_step_3d(model, optimizer, plan, remat=remat)
+
+    def step(state: TrainState, tokens, _labels):
+        b = tokens.shape[0]
+        if b % (grad_accum * microbatches) != 0:
+            raise ValueError(
+                f"batch {b} not divisible by grad_accum*microbatches="
+                f"{grad_accum * microbatches}")
+        toks = tokens.reshape(grad_accum, microbatches,
+                              b // (grad_accum * microbatches),
+                              tokens.shape[-1])
+        new_params, new_opt, m = inner(state.params, state.opt_state, toks)
+        return (TrainState(new_params, state.batch_stats, new_opt,
+                           state.step + 1), m)
+
+    return step
 
 
 def make_eval_step(model, mesh: Optional[Mesh] = None):
@@ -488,6 +669,14 @@ def fit_epochs_resumable(
     own_guard = guard is not None and not guard.running
     if own_guard:
         guard.start()
+    def _on_corrupt(step, path):
+        # corrupt checkpoints walked past get moved aside on disk AND
+        # recorded in the guard's persisted ledger — the walk-back and
+        # the quarantine must never disagree about which steps are dead
+        if guard is not None:
+            guard.quarantine_checkpoint(step, path)
+            guard.save_quarantine(qpath)
+
     try:
         if guard is not None:
             guard.load_quarantine(qpath)
@@ -497,8 +686,10 @@ def fit_epochs_resumable(
             try:
                 # self-healing resume: newest checkpoint that VERIFIES
                 # (corrupt ones are walked past, counting
-                # checkpoint.corrupt/fallback)
-                state, g = mgr.restore_verified(template=state)
+                # checkpoint.corrupt/fallback, quarantined on disk)
+                state, g = mgr.restore_verified(
+                    template=state, on_corrupt=_on_corrupt,
+                    quarantine=True)
                 core_telemetry.incr("training.resume")
             except FileNotFoundError:
                 # every checkpoint corrupt: start fresh rather than die
@@ -588,7 +779,9 @@ def fit_epochs_resumable(
                     try:
                         # new_state (not the donated pre-step state) is
                         # the only guaranteed-alive template
-                        state, g = mgr.restore_verified(template=new_state)
+                        state, g = mgr.restore_verified(
+                            template=new_state, on_corrupt=_on_corrupt,
+                            quarantine=True)
                     except FileNotFoundError as e:
                         core_telemetry.incr("training.abort")
                         raise TrainingAborted(

@@ -1,5 +1,7 @@
 from .mesh import (
+    MESH_AXIS_NAMES,
     MeshContext,
+    MeshPlan,
     batch_sharding,
     default_mesh,
     make_mesh,

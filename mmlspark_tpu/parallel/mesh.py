@@ -42,7 +42,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
+    "MESH_AXIS_NAMES",
     "make_mesh",
+    "MeshPlan",
     "default_mesh",
     "MeshContext",
     "batch_sharding",
@@ -51,6 +53,13 @@ __all__ = [
     "shard_batch",
     "pad_to_multiple",
 ]
+
+# Every axis name a mesh in this codebase may declare.  graftlint G305
+# checks any axis literal inside a PartitionSpec against this tuple (a
+# typo'd axis name does not error — XLA silently replicates the leaf),
+# and sharding_rules.validate_rules does the same at runtime.  Keep it a
+# plain tuple literal: the lint parses it via AST without importing jax.
+MESH_AXIS_NAMES = ("data", "model", "seq", "pipe")
 
 _CURRENT: Dict[str, Optional[Mesh]] = {"mesh": None}
 
@@ -110,6 +119,55 @@ def make_mesh(
     else:
         arr = np.asarray(devices).reshape(data, model, seq)
     return Mesh(arr, axis_names=("data", "model", "seq"))
+
+
+class MeshPlan:
+    """One (data, model, pipe) layout for the 3D-mesh GSPMD trainer:
+    D-way data parallelism x T-way megatron tensor parallelism x P-way
+    GPipe pipeline parallelism on a SINGLE mesh, so XLA composes all
+    three collective families in one program (the make_lm_train_step_3d
+    substrate; docs/performance.md "The 3D mesh").
+
+    ``data=-1`` absorbs the remaining devices.  The axis names are the
+    plan's contract with every partition-rule table — `validate_specs`
+    is the runtime check graftlint G305 performs statically."""
+
+    AXES = ("data", "model", "pipe")
+
+    def __init__(self, data: int = -1, model: int = 1, pipe: int = 1,
+                 devices: Optional[Sequence] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        if model < 1 or pipe < 1:
+            raise ValueError(f"model={model} and pipe={pipe} must be >= 1")
+        if data == -1:
+            if n % (model * pipe) != 0:
+                raise ValueError(
+                    f"{n} devices not divisible by model*pipe="
+                    f"{model * pipe}")
+            data = n // (model * pipe)
+        if data * model * pipe != n:
+            raise ValueError(
+                f"mesh plan {data}x{model}x{pipe} != {n} devices")
+        self.data, self.model, self.pipe = int(data), int(model), int(pipe)
+        arr = np.asarray(devices).reshape(self.data, self.model, self.pipe)
+        self.mesh = Mesh(arr, axis_names=self.AXES)
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(self.mesh.shape)
+
+    def validate_specs(self, rules) -> None:
+        """Raise if any rule's spec names an axis this plan's mesh does
+        not declare (the silent-full-replication typo G305 catches in
+        source)."""
+        from .sharding_rules import validate_rules
+
+        validate_rules(rules, self.AXES)
+
+    def __repr__(self) -> str:
+        return (f"MeshPlan(data={self.data}, model={self.model}, "
+                f"pipe={self.pipe})")
 
 
 def default_mesh() -> Mesh:

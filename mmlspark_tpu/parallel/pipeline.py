@@ -23,11 +23,11 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import shard_map
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = ["pipeline_apply", "gpipe_spmd_apply", "stack_stage_params"]
 
 
 def stack_stage_params(per_stage_params):
@@ -90,3 +90,64 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jnp.ndarray,
         per_stage, mesh=mesh,
         in_specs=(spec_p, P()), out_specs=P(),
     )(stacked_params, x)
+
+
+def gpipe_spmd_apply(stage_fn: Callable, stacked_params, x: jnp.ndarray,
+                     mesh: Mesh = None, axis: str = "pipe",
+                     batch_axis: str = "data") -> jnp.ndarray:
+    """The SAME M + P - 1 GPipe schedule as :func:`pipeline_apply`,
+    lowered through GSPMD sharding annotations instead of shard_map —
+    which is what lets it COMPOSE with data-parallel batch sharding and
+    megatron tensor rules on one 3D mesh (shard_map bodies see local
+    arrays; tensor-parallel collectives inside them would have to be
+    hand-written, and jax 0.4.x cannot mix auto axes in).
+
+    ``x [M, mb, ...]`` microbatches; ``stacked_params`` leaves carry a
+    leading stage dim P (any further leading dims — e.g. the
+    [P, K_blocks] layout of ``lm_params_to_3d`` — are stage-private).
+    The schedule is a `lax.scan` whose donated carry is the [P, mb, ...]
+    activation buffer: each tick runs every stage in parallel
+    (``jax.vmap`` over the stage dim, which XLA partitions over the pipe
+    axis), then the buffer rolls one stage forward — `jnp.roll` on a
+    pipe-sharded dim lowers to the same collective-permute hop
+    pipeline_apply issues by hand.  Differentiable end to end; returns
+    [M, mb, ...] equal to applying the stages sequentially.
+    """
+    leading = {a.shape[0] for a in jax.tree.leaves(stacked_params)}
+    if len(leading) != 1:
+        raise ValueError(
+            f"stacked_params leading dims differ: {sorted(leading)}")
+    (p,) = leading
+    if mesh is not None and axis in mesh.shape and mesh.shape[axis] != p:
+        raise ValueError(
+            f"stacked_params leading dim {p} != mesh axis {axis!r} size "
+            f"{mesh.shape[axis]} — one stage per pipe rank")
+    m = x.shape[0]
+    steps = m + p - 1
+    vstage = jax.vmap(stage_fn)
+
+    def pin(buf):
+        # keep the buffer stage-dim on the pipe axis and the microbatch
+        # dim data-sharded at every tick, so the roll stays a pure
+        # neighbor hop instead of a resharding
+        if mesh is None:
+            return buf
+        return jax.lax.with_sharding_constraint(
+            buf, NamedSharding(mesh, P(axis, batch_axis)))
+
+    def body(buf, t):
+        # stage 0 ingests microbatch t (clamped: drain ticks feed a dead
+        # row that ys slicing discards); stages 1..P-1 consume what the
+        # previous tick rolled to them
+        inp = jax.lax.dynamic_index_in_dim(x, jnp.clip(t, 0, m - 1), 0,
+                                           keepdims=False)
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, inp.astype(buf.dtype), 0, 0)
+        out = pin(vstage(stacked_params, buf))
+        # the LAST stage's output at tick t is microbatch t - (P-1)
+        y = out[p - 1]
+        return pin(jnp.roll(out, 1, axis=0)), y
+
+    buf0 = pin(jnp.zeros((p,) + x.shape[1:], x.dtype))
+    _, ys = jax.lax.scan(body, buf0, jnp.arange(steps))
+    return ys[p - 1:]

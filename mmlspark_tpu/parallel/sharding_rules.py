@@ -1,54 +1,195 @@
-"""Canonical parameter-sharding rules for the model families.
+"""Partition-rule library: regex-over-pytree sharding in the fmengine /
+EasyLM style (SNIPPETS.md [1]).
 
-One place for the `(path, arr) -> PartitionSpec` functions that
-`models.training.shard_params` consumes — the graft-entry dryrun, tests,
-and user code previously each hand-rolled the same name matching.
+A *rule table* is an ordered sequence of ``(regex, PartitionSpec)``
+pairs.  Every parameter leaf is named by its ``/``-joined tree path
+(``block0/qkv/kernel``); the FIRST rule whose regex ``re.search``-matches
+that name wins, so specific rules go first and a ``(".*", P())``
+catch-all closes every table.  Scalars and size-1 leaves always
+replicate — a PartitionSpec on a scalar is meaningless and XLA would
+reject most of them anyway.
 
-Rules return None/P() to replicate; XLA inserts the collectives implied
-by whatever they shard (tensor parallelism for block kernels, expert
-parallelism for MoE expert dims).
+``match_partition_rules(rules, tree)`` turns a table into a spec tree;
+``make_shard_and_gather_fns(specs, mesh)`` turns a spec tree into
+per-leaf placement/collection closures; ``models.training.shard_params``
+consumes either a table or (legacy) a ``(path, arr) -> spec`` callable.
+The historical rule callables (``lm_tensor_parallel_rules`` & co.) are
+kept as thin adapters over their tables — ONE matcher implementation,
+everywhere.
+
+Axis-name hygiene: every axis literal in a spec must be an axis the
+mesh actually declares (``parallel.mesh.MESH_AXIS_NAMES``) — a typo'd
+axis silently replicates the leaf.  graftlint G305 enforces this
+statically; ``validate_rules`` enforces it at runtime for dynamically
+built tables.
 """
 from __future__ import annotations
 
-from jax.sharding import PartitionSpec as P
+import re
+from typing import Iterable, Sequence, Tuple
 
-__all__ = ["path_names", "lm_tensor_parallel_rules",
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["path_names", "path_name", "match_partition_rules",
+           "spec_for", "make_shard_and_gather_fns", "validate_rules",
+           "lm_tensor_rules", "moe_expert_rules", "head_only_rules",
+           "lm_3d_rules", "lm_tensor_parallel_rules",
            "moe_expert_parallel_rules", "head_rules"]
+
+RuleTable = Sequence[Tuple[str, P]]
 
 
 def path_names(path):
-    """Flax/jax tree path entries -> their string names."""
-    return [getattr(p, "key", getattr(p, "name", "")) for p in path]
+    """Flax/jax tree path entries -> their string names (DictKey.key,
+    GetAttrKey.name, SequenceKey.idx)."""
+    out = []
+    for p in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                out.append(str(getattr(p, attr)))
+                break
+        else:
+            out.append(str(p))
+    return out
 
 
-def lm_tensor_parallel_rules(path, arr, axis: str = "model"):
+def path_name(path) -> str:
+    """The ``/``-joined leaf name rule regexes match against."""
+    return "/".join(path_names(path))
+
+
+def _leaf_shape(leaf):
+    shape = getattr(leaf, "shape", None)
+    return tuple(shape) if shape is not None else ()
+
+
+def spec_for(rules: RuleTable, name: str, leaf=None) -> P:
+    """First-match-wins lookup of one leaf's PartitionSpec.  Scalar /
+    size-1 leaves replicate unconditionally; a leaf no rule matches
+    raises (a silent default would be exactly the silent-replication
+    bug rule tables exist to prevent — close tables with ``(".*",
+    P())`` when replication IS the intent)."""
+    if leaf is not None:
+        shape = _leaf_shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            return P()
+    for pattern, spec in rules:
+        if re.search(pattern, name):
+            return spec
+    raise ValueError(
+        f"no partition rule matched leaf {name!r} — add a rule (or a "
+        f'catch-all (".*", P()) row) to the table')
+
+
+def match_partition_rules(rules: RuleTable, tree):
+    """Spec tree for `tree`: each leaf gets the first rule whose regex
+    matches its ``/``-joined path name (scalars replicate)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for(rules, path_name(path), leaf), tree)
+
+
+def make_shard_and_gather_fns(partition_specs, mesh: Mesh):
+    """(shard_fns, gather_fns) trees matching `partition_specs`:
+    shard_fn places a host leaf onto the mesh under its spec;
+    gather_fn pulls a (possibly sharded) leaf back to host numpy —
+    the save/restore side of the same rule table."""
+    is_spec = lambda x: isinstance(x, P)
+
+    def mk_shard(spec):
+        sharding = NamedSharding(mesh, spec)
+        return lambda x: jax.device_put(x, sharding)
+
+    def mk_gather(_spec):
+        return lambda x: np.asarray(jax.device_get(x))
+
+    shard_fns = jax.tree.map(mk_shard, partition_specs, is_leaf=is_spec)
+    gather_fns = jax.tree.map(mk_gather, partition_specs, is_leaf=is_spec)
+    return shard_fns, gather_fns
+
+
+def validate_rules(rules: RuleTable, axes: Iterable[str]) -> None:
+    """Every axis name any rule's spec mentions must be a declared mesh
+    axis — the runtime twin of graftlint G305 (a typo'd axis name makes
+    XLA silently replicate the leaf; nothing errors, MFU just dies)."""
+    axes = set(axes)
+    for pattern, spec in rules:
+        for entry in tuple(spec):
+            names = entry if isinstance(entry, (tuple, list)) else (entry,)
+            for n in names:
+                if n is not None and n not in axes:
+                    raise ValueError(
+                        f"rule {pattern!r} uses axis {n!r} not in the "
+                        f"mesh axes {sorted(axes)} — a typo here would "
+                        f"silently replicate the leaf")
+
+
+# ------------------------------------------------------------ rule tables
+
+def lm_tensor_rules(axis: str = "model") -> RuleTable:
     """TransformerLM block/head kernels over the tensor axis: qkv/mlp_in/
     head shard output features, proj/mlp_out shard input features (the
-    megatron pairing — one all-reduce per block, none inside the MLP)."""
-    names = path_names(path)
-    # 'qkv' is the fused MHA projection; GQA splits it into 'q' + 'kv'
-    if arr.ndim == 2 and any(n in names for n in
-                             ("qkv", "q", "kv", "mlp_in", "head")):
-        return P(None, axis)
-    if arr.ndim == 2 and any(n in names for n in ("proj", "mlp_out")):
-        return P(axis, None)
-    return P()
+    megatron pairing — one all-reduce per block, none inside the MLP).
+    'qkv' is the fused MHA projection; GQA splits it into 'q' + 'kv'."""
+    return (
+        (r"(^|/)(qkv|q|kv|mlp_in|head)/kernel$", P(None, axis)),
+        (r"(^|/)(proj|mlp_out)/kernel$", P(axis, None)),
+        (r".*", P()),
+    )
 
 
-def moe_expert_parallel_rules(path, arr, axis: str = "model"):
+def moe_expert_rules(axis: str = "model") -> RuleTable:
     """Shard the EXPERT dim of switch-MoE w_in/w_out (expert parallelism);
     everything else replicates."""
-    names = path_names(path)
-    if ("moe" in names and arr.ndim == 3
-            and any(n in names for n in ("w_in", "w_out"))):
-        return P(axis, None, None)
-    return P()
+    return (
+        (r"(^|/)moe/(w_in|w_out)$", P(axis, None, None)),
+        (r".*", P()),
+    )
 
 
-def head_rules(path, arr, axis: str = "model"):
+def head_only_rules(axis: str = "model") -> RuleTable:
     """Classifier-head-only sharding (the CNN fine-tune shape: one big
     dense head, convs replicated)."""
-    names = path_names(path)
-    if "head" in names and arr.ndim >= 2:
-        return P(None, axis)
-    return P()
+    return (
+        (r"(^|/)head/kernel$", P(None, axis)),
+        (r".*", P()),
+    )
+
+
+def lm_3d_rules(tensor_axis: str = "model",
+                pipe_axis: str = "pipe") -> RuleTable:
+    """Rules for the STACKED 3D-trainer layout (``lm_params_to_3d``):
+    block params carry leading [P_stages, K_blocks] dims sharded over the
+    pipe axis, with the megatron tensor pairing on the trailing kernel
+    dims; embed/ln replicate; head shards its vocab dim."""
+    return (
+        (r"^blocks/.*(qkv|q|kv|mlp_in)/kernel$",
+         P(pipe_axis, None, None, tensor_axis)),
+        (r"^blocks/.*(proj|mlp_out)/kernel$",
+         P(pipe_axis, None, tensor_axis, None)),
+        (r"^blocks/.*moe/(w_in|w_out)$",
+         P(pipe_axis, None, tensor_axis, None, None)),
+        # everything else under blocks/ (ln scale/bias, dense biases,
+        # router) shards only its stage dim
+        (r"^blocks/", P(pipe_axis)),
+        (r"^out/head/kernel$", P(None, tensor_axis)),
+        (r".*", P()),
+    )
+
+
+# ------------------------------------------ legacy callable adapters
+# The pre-rule-library surface: (path, arr) -> spec callables.  Each is
+# now a one-line lookup into its table — the name matching lives in ONE
+# place (spec_for) instead of three hand-rolled copies.
+
+def lm_tensor_parallel_rules(path, arr, axis: str = "model") -> P:
+    return spec_for(lm_tensor_rules(axis), path_name(path), arr)
+
+
+def moe_expert_parallel_rules(path, arr, axis: str = "model") -> P:
+    return spec_for(moe_expert_rules(axis), path_name(path), arr)
+
+
+def head_rules(path, arr, axis: str = "model") -> P:
+    return spec_for(head_only_rules(axis), path_name(path), arr)

@@ -555,6 +555,38 @@ class TestG3Registries:
                  '    name = "whatever"\n')
         assert g3._stage_findings([sf], self._G405_DECLARED) == []
 
+    # ------------------------------------------------ G305: mesh axes
+
+    def test_g305_typod_axis_in_p_call(self):
+        sf = _sf("from jax.sharding import PartitionSpec as P\n"
+                 'good = P(None, "model")\n'
+                 'bad = P(None, "modle")\n')
+        found = g3._spec_axis_findings([sf], ROOT)
+        assert _rules(found) == ["G305"]
+        assert "modle" in found[0].message and found[0].line == 3
+
+    def test_g305_tuple_entry_and_full_name(self):
+        sf = _sf("from jax.sharding import PartitionSpec\n"
+                 'a = PartitionSpec(("data", "oops"), None)\n')
+        found = g3._spec_axis_findings([sf], ROOT)
+        assert _rules(found) == ["G305"]
+        assert "oops" in found[0].message
+
+    def test_g305_declared_axes_parse_from_mesh_py(self):
+        axes = g3.declared_mesh_axes(ROOT)
+        assert {"data", "model", "seq", "pipe"} <= axes
+
+    def test_g305_file_without_partitionspec_is_skipped(self):
+        # P() is a common short name (e.g. a probability fn): only files
+        # that import/mention PartitionSpec are in scope
+        sf = _sf('x = P(None, "not_an_axis")\n')
+        assert g3._spec_axis_findings([sf], ROOT) == []
+
+    def test_g305_suppression(self):
+        sf = _sf("from jax.sharding import PartitionSpec as P\n"
+                 'x = P("custom")  # graftlint: disable=G305\n')
+        assert g3._spec_axis_findings([sf], ROOT) == []
+
 
 # ------------------------------------------------------------------ G4
 

@@ -15,6 +15,8 @@ anywhere:
                                             # incident -> resolve
     python tools/ci.py flow-soak            # graftflow runtime chaos soak
     python tools/ci.py feed-bench           # 3-path h2d transfer smoke
+    python tools/ci.py parity-3d            # 3D-mesh trainer == single-
+                                            # device losses (8-dev mesh)
     python tools/ci.py sanitize [--json]    # all soaks under GRAFTSAN=1
                                             # (tools/graftsan runtime
                                             # concurrency sanitizer)
@@ -340,6 +342,26 @@ def feed_bench_smoke(timeout_s: int = 300) -> int:
     return rc
 
 
+def parity_3d(timeout_s: int = 600) -> int:
+    """Run tools/parity3d.py on the virtual 8-device CPU mesh: the
+    composed (data x tensor x pipe) 3D GSPMD train step must reproduce
+    the single-device loss trajectory (2 steps, bf16 atol) for every
+    swept layout.  The cheap CI proof that a sharding-rule or pipeline-
+    schedule change didn't silently alter the math."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=8")
+               .strip())
+    cmd = [sys.executable, os.path.join("tools", "parity3d.py")]
+    try:
+        rc = subprocess.call(cmd, cwd=ROOT, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"parity-3d timed out after {timeout_s}s")
+        return 1
+    print("parity-3d:", "OK" if rc == 0 else f"FAILED (rc={rc})")
+    return rc
+
+
 def flow_soak(timeout_s: int = 300) -> int:
     """Run the graftflow runtime soak (tools/chaos_soak.py --flow) as a
     smoke job: seeded faults at every registered flow.* point, bounded-
@@ -398,7 +420,7 @@ def main(argv=None):
                                         "perf-gate", "fleet-smoke",
                                         "obs-soak", "train-soak",
                                         "flow-soak", "feed-bench",
-                                        "sanitize", "all"])
+                                        "parity-3d", "sanitize", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
                     help="run only this shard index (CI matrix job)")
@@ -434,6 +456,8 @@ def main(argv=None):
         return flow_soak()
     if args.command == "feed-bench":
         return feed_bench_smoke()
+    if args.command == "parity-3d":
+        return parity_3d()
     if args.command == "sanitize":
         return sanitize(json_out=args.json)
     if args.command == "test":

@@ -1,0 +1,93 @@
+"""3D-trainer parity leg: the fast CI proof that the composed
+(data x tensor x pipe) GSPMD step computes the SAME training trajectory
+as the plain single-device step.
+
+Run via ``python -m tools.ci parity-3d`` (which forces JAX_PLATFORMS=cpu
+and an 8-device virtual mesh before this process imports jax) or
+directly with the same env.  For each swept ``(D, T, P)`` layout the 3D
+step trains a tiny bf16 TransformerLM for 2 steps on identical data and
+both the per-step losses and the final params must match the
+single-device reference at bf16-accumulation tolerance.  2 steps, not 1:
+step 2 consumes step 1's updated params, so a wrong gradient anywhere
+(a dropped microbatch, a mis-rolled pipeline buffer, a double-counted
+accumulation chunk) compounds and cannot cancel.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+LAYOUTS = (((8, 1, 1), (2, 1)), ((2, 4, 1), (2, 2)), ((2, 2, 2), (2, 2)))
+ATOL = 2e-2  # bf16 accumulation-order tolerance on an ~6.7 initial loss
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    if len(jax.devices()) < 8:
+        print("parity-3d: needs an 8-device mesh "
+              f"(got {len(jax.devices())}) — set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8",
+              file=sys.stderr)
+        return 2
+
+    from mmlspark_tpu.models.training import (lm_params_to_3d,
+                                              make_lm_train_step_3d,
+                                              shard_params)
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.parallel.mesh import MeshPlan
+    from mmlspark_tpu.parallel.sharding_rules import lm_3d_rules
+
+    V, E, L, H, S = 512, 64, 4, 4, 32
+    model = transformer_lm(vocab_size=V, embed_dim=E, num_layers=L,
+                           num_heads=H, max_len=S, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16, S), 0, V,
+                              jnp.int32)
+    params = model.init(rng, toks[0, :2])["params"]
+    opt = optax.sgd(0.1)
+
+    def ref_step(p, o, t):
+        def loss_fn(p):
+            logits, _ = model.apply({"params": p}, t)
+            return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), t[:, 1:]))
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        up, o = opt.update(grads, o, p)
+        return optax.apply_updates(p, up), o, loss
+
+    p_ref, o_ref = params, opt.init(params)
+    ref_losses = []
+    for i in range(2):
+        p_ref, o_ref, l = ref_step(p_ref, o_ref, toks[i])
+        ref_losses.append(float(l))
+
+    failed = False
+    for (d, t, p), (a, m) in LAYOUTS:
+        plan = MeshPlan(data=d, model=t, pipe=p)
+        p3 = shard_params(lm_params_to_3d(params, L, p), plan.mesh,
+                          lm_3d_rules())
+        o3 = opt.init(p3)
+        step = make_lm_train_step_3d(model, opt, plan, remat=True,
+                                     donate=False)
+        diffs = []
+        for i in range(2):
+            tb = toks[i].reshape(a, m, 16 // (a * m), S)
+            p3, o3, metrics = step(p3, o3, tb)
+            diffs.append(abs(float(metrics["loss"]) - ref_losses[i]))
+        ok = max(diffs) <= ATOL
+        failed |= not ok
+        print(f"parity-3d ({d},{t},{p}): max loss diff "
+              f"{max(diffs):.2e} (atol {ATOL:.0e}) "
+              f"{'ok' if ok else 'FAIL'}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,11 @@ GATE_METRICS: Dict[str, Tuple[str, float, float]] = {
     "vit_mfu": ("higher", 0.10, 0.0),
     "lm_tokens_per_sec": ("higher", 0.10, 0.0),
     "lm_train_mfu": ("higher", 0.10, 0.0),
+    # the 3D-mesh GSPMD trainer's sharded step (bench --lm3d sweep, best
+    # layout).  Runs on the virtual CPU mesh, so the band is wide — the
+    # gate exists to catch a broken schedule (2x step time from a lost
+    # sharding constraint), not CPU timer noise
+    "lm3d_step_ms": ("lower", 0.50, 50.0),
     "decode_ips": ("higher", 0.20, 0.0),
     # h2d_gbps direction=up is the ISSUE-14 lock-in: a regression back to
     # the pre-sharded slow path fails the gate, not just the dashboard
