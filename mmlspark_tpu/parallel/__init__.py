@@ -9,3 +9,9 @@ from .mesh import (
     shard_batch,
 )
 from .distributed import initialize_distributed, barrier
+from .sharding_rules import (
+    PARAM_PATH_MANIFEST,
+    match_partition_rules,
+    validate_coverage,
+    validate_rules,
+)

@@ -54,10 +54,11 @@ __all__ = [
     "pad_to_multiple",
 ]
 
-# Every axis name a mesh in this codebase may declare.  graftlint G305
-# checks any axis literal inside a PartitionSpec against this tuple (a
-# typo'd axis name does not error — XLA silently replicates the leaf),
-# and sharding_rules.validate_rules does the same at runtime.  Keep it a
+# Every axis name a mesh in this codebase may declare.  graftlint G501
+# (né G305) checks any axis literal inside a PartitionSpec — and any
+# collective's axis_name — against this tuple (a typo'd axis name does
+# not error — XLA silently replicates the leaf), and
+# sharding_rules.validate_rules does the same at runtime.  Keep it a
 # plain tuple literal: the lint parses it via AST without importing jax.
 MESH_AXIS_NAMES = ("data", "model", "seq", "pipe")
 
@@ -130,7 +131,7 @@ class MeshPlan:
 
     ``data=-1`` absorbs the remaining devices.  The axis names are the
     plan's contract with every partition-rule table — `validate_specs`
-    is the runtime check graftlint G305 performs statically."""
+    is the runtime check graftlint G501 (né G305) performs statically."""
 
     AXES = ("data", "model", "pipe")
 
@@ -159,7 +160,7 @@ class MeshPlan:
 
     def validate_specs(self, rules) -> None:
         """Raise if any rule's spec names an axis this plan's mesh does
-        not declare (the silent-full-replication typo G305 catches in
+        not declare (the silent-full-replication typo G501 catches in
         source)."""
         from .sharding_rules import validate_rules
 

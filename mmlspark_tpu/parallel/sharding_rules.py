@@ -19,9 +19,12 @@ everywhere.
 
 Axis-name hygiene: every axis literal in a spec must be an axis the
 mesh actually declares (``parallel.mesh.MESH_AXIS_NAMES``) — a typo'd
-axis silently replicates the leaf.  graftlint G305 enforces this
-statically; ``validate_rules`` enforces it at runtime for dynamically
-built tables.
+axis silently replicates the leaf.  graftlint G501 (né G305) enforces
+this statically; ``validate_rules`` enforces it at runtime for
+dynamically built tables.  ``PARAM_PATH_MANIFEST`` below is the
+coverage side of the same contract: the representative leaf paths the
+models actually produce, against which graftlint G503 (and the runtime
+``validate_coverage``) prove every table matches every leaf.
 """
 from __future__ import annotations
 
@@ -34,11 +37,58 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["path_names", "path_name", "match_partition_rules",
            "spec_for", "make_shard_and_gather_fns", "validate_rules",
+           "validate_coverage", "PARAM_PATH_MANIFEST",
            "lm_tensor_rules", "moe_expert_rules", "head_only_rules",
            "lm_3d_rules", "lm_tensor_parallel_rules",
            "moe_expert_parallel_rules", "head_rules"]
 
 RuleTable = Sequence[Tuple[str, P]]
+
+# Representative parameter leaf paths, one per distinct naming shape the
+# models emit — the coverage manifest graftlint G503 checks every
+# literal rule table against (and `validate_coverage` re-checks at
+# runtime for dynamically built tables).  Two layouts are represented:
+# the flax ``block{i}`` tree TransformerLM.init produces, and the
+# stacked 3D layout ``models.training.lm_params_to_3d`` rearranges it
+# into.  Kept a plain tuple literal of string constants: the lint
+# AST-parses it (no jax import), same contract as MESH_AXIS_NAMES.
+# Adding a differently-named param to a model without a row here is a
+# G503 finding; adding a row no table matches is one too.
+PARAM_PATH_MANIFEST: Tuple[str, ...] = (
+    # flax block{i} layout (TransformerLM / TransformerDecode)
+    "tok_embed/embedding",
+    "pos_embed/embedding",
+    "block0/ln1/scale",
+    "block0/ln1/bias",
+    "block0/qkv/kernel",
+    "block0/q/kernel",
+    "block0/kv/kernel",
+    "block0/proj/kernel",
+    "block0/ln2/scale",
+    "block0/mlp_in/kernel",
+    "block0/mlp_in/bias",
+    "block0/mlp_out/kernel",
+    "block0/moe/router/kernel",
+    "block0/moe/w_in",
+    "block0/moe/w_out",
+    "ln_f/scale",
+    "head/kernel",
+    # stacked 3D layout (models.training.lm_params_to_3d)
+    "embed/tok_embed/embedding",
+    "embed/pos_embed/embedding",
+    "blocks/ln1/scale",
+    "blocks/qkv/kernel",
+    "blocks/q/kernel",
+    "blocks/kv/kernel",
+    "blocks/proj/kernel",
+    "blocks/mlp_in/kernel",
+    "blocks/mlp_out/kernel",
+    "blocks/moe/router/kernel",
+    "blocks/moe/w_in",
+    "blocks/moe/w_out",
+    "out/ln_f/scale",
+    "out/head/kernel",
+)
 
 
 def path_names(path):
@@ -123,6 +173,16 @@ def validate_rules(rules: RuleTable, axes: Iterable[str]) -> None:
                         f"rule {pattern!r} uses axis {n!r} not in the "
                         f"mesh axes {sorted(axes)} — a typo here would "
                         f"silently replicate the leaf")
+
+
+def validate_coverage(rules: RuleTable,
+                      paths: Iterable[str] = PARAM_PATH_MANIFEST) -> None:
+    """Every manifest path must match some rule — the runtime twin of
+    graftlint G503 for tables built dynamically (where the static pass
+    sees no literal).  Raises on the first uncovered path, naming it,
+    instead of letting `spec_for` raise mid-shard on a real tree."""
+    for name in paths:
+        spec_for(rules, name)  # raises ValueError on no match
 
 
 # ------------------------------------------------------------ rule tables

@@ -16,6 +16,7 @@ from tools.graftlint.g1_trace import check_trace_purity  # noqa: E402
 from tools.graftlint.g2_locks import check_lock_discipline  # noqa: E402
 from tools.graftlint import g3_registry as g3  # noqa: E402
 from tools.graftlint import g4_hygiene as g4  # noqa: E402
+from tools.graftlint import g5_spmd as g5  # noqa: E402
 
 
 def _sf(src: str, rel: str = "mmlspark_tpu/fake/mod.py") -> gl_core.SourceFile:
@@ -159,6 +160,82 @@ def step(x):
 fast = jax.jit(step)
 """
         assert check_trace_purity([_sf(src)]) == []
+
+    # --------------------------------- cross-module call graph (PR 18)
+
+    def test_hazard_in_helper_imported_from_sibling_module(self):
+        # module A's jitted step calls module B's helper; the hazard
+        # lives in B.  The single-module pass could not see this edge.
+        helper = _sf("import time\n"
+                     "def probe(x):\n"
+                     "    t0 = time.perf_counter()\n"
+                     "    return x\n",
+                     rel="mmlspark_tpu/fake/helper.py")
+        step = _sf("import jax\n"
+                   "from .helper import probe\n"
+                   "def step(x):\n"
+                   "    return probe(x)\n"
+                   "fast = jax.jit(step)\n",
+                   rel="mmlspark_tpu/fake/step.py")
+        found = check_trace_purity([helper, step])
+        assert _rules(found) == ["G102"]
+        assert found[0].path == "mmlspark_tpu/fake/helper.py"
+        assert found[0].symbol == "probe"
+
+    def test_jit_of_directly_imported_function(self):
+        # jax.jit(imported_fn) roots the DEFINING module's function
+        impure = _sf("def kernel(x):\n"
+                     "    print(x)\n"
+                     "    return x\n",
+                     rel="mmlspark_tpu/fake/impure.py")
+        user = _sf("import jax\n"
+                   "from .impure import kernel\n"
+                   "fast = jax.jit(kernel)\n",
+                   rel="mmlspark_tpu/fake/user.py")
+        found = check_trace_purity([impure, user])
+        assert _rules(found) == ["G104"]
+        assert found[0].path == "mmlspark_tpu/fake/impure.py"
+
+    def test_reexport_through_package_init(self):
+        # A imports the helper via the package __init__ re-export; the
+        # graph chases `from .helper import probe` one hop
+        helper = _sf("import random\n"
+                     "def probe(x):\n"
+                     "    return x * random.random()\n",
+                     rel="mmlspark_tpu/fake/helper.py")
+        init = _sf("from .helper import probe\n",
+                   rel="mmlspark_tpu/fake/__init__.py")
+        step = _sf("import jax\n"
+                   "from mmlspark_tpu.fake import probe\n"
+                   "def step(x):\n"
+                   "    return probe(x)\n"
+                   "fast = jax.jit(step)\n",
+                   rel="mmlspark_tpu/other/step.py")
+        found = check_trace_purity([helper, init, step])
+        assert _rules(found) == ["G103"]
+        assert found[0].path == "mmlspark_tpu/fake/helper.py"
+
+    def test_unresolvable_import_is_a_boundary(self):
+        # calls into modules the tree does not contain (jax itself,
+        # telemetry facades) stay boundaries: no findings, no crash
+        step = _sf("import jax\n"
+                   "from somewhere.else_ import mystery\n"
+                   "def step(x):\n"
+                   "    return mystery(x)\n"
+                   "fast = jax.jit(step)\n",
+                   rel="mmlspark_tpu/fake/step.py")
+        assert check_trace_purity([step]) == []
+
+    def test_cross_module_suppression_at_hazard_site(self):
+        helper = _sf("def probe(x):\n"
+                     "    print(x)  # graftlint: disable=G104\n"
+                     "    return x\n",
+                     rel="mmlspark_tpu/fake/helper.py")
+        step = _sf("import jax\n"
+                   "from .helper import probe\n"
+                   "fast = jax.jit(probe)\n",
+                   rel="mmlspark_tpu/fake/step.py")
+        assert check_trace_purity([helper, step]) == []
 
 
 # ------------------------------------------------------------------ G2
@@ -555,37 +632,64 @@ class TestG3Registries:
                  '    name = "whatever"\n')
         assert g3._stage_findings([sf], self._G405_DECLARED) == []
 
-    # ------------------------------------------------ G305: mesh axes
+    # --------------------------- G501 (né G305): mesh axes, now in G5
 
-    def test_g305_typod_axis_in_p_call(self):
+    def test_g501_typod_axis_in_p_call(self):
         sf = _sf("from jax.sharding import PartitionSpec as P\n"
                  'good = P(None, "model")\n'
                  'bad = P(None, "modle")\n')
-        found = g3._spec_axis_findings([sf], ROOT)
-        assert _rules(found) == ["G305"]
+        found = g5._spec_axis_findings([sf], ROOT)
+        assert _rules(found) == ["G501"]
         assert "modle" in found[0].message and found[0].line == 3
 
-    def test_g305_tuple_entry_and_full_name(self):
+    def test_g501_tuple_entry_and_full_name(self):
         sf = _sf("from jax.sharding import PartitionSpec\n"
                  'a = PartitionSpec(("data", "oops"), None)\n')
-        found = g3._spec_axis_findings([sf], ROOT)
-        assert _rules(found) == ["G305"]
+        found = g5._spec_axis_findings([sf], ROOT)
+        assert _rules(found) == ["G501"]
         assert "oops" in found[0].message
 
-    def test_g305_declared_axes_parse_from_mesh_py(self):
+    def test_g501_declared_axes_parse_from_mesh_py(self):
+        # g3 re-exports declared_mesh_axes for its historical callers
         axes = g3.declared_mesh_axes(ROOT)
+        assert axes == g5.declared_mesh_axes(ROOT)
         assert {"data", "model", "seq", "pipe"} <= axes
 
-    def test_g305_file_without_partitionspec_is_skipped(self):
+    def test_g501_file_without_partitionspec_is_skipped(self):
         # P() is a common short name (e.g. a probability fn): only files
         # that import/mention PartitionSpec are in scope
         sf = _sf('x = P(None, "not_an_axis")\n')
-        assert g3._spec_axis_findings([sf], ROOT) == []
+        assert g5._spec_axis_findings([sf], ROOT) == []
 
-    def test_g305_suppression(self):
+    def test_g501_suppression(self):
+        sf = _sf("from jax.sharding import PartitionSpec as P\n"
+                 'x = P("custom")  # graftlint: disable=G501\n')
+        assert g5._spec_axis_findings([sf], ROOT) == []
+
+    def test_g305_alias_still_suppresses(self):
+        # the old rule id keeps working in disable comments ...
         sf = _sf("from jax.sharding import PartitionSpec as P\n"
                  'x = P("custom")  # graftlint: disable=G305\n')
-        assert g3._spec_axis_findings([sf], ROOT) == []
+        assert g5._spec_axis_findings([sf], ROOT) == []
+
+    def test_g305_alias_canonicalizes(self):
+        # ... and in --rules selection / baseline keys via canonical_rule
+        assert gl_core.canonical_rule("G305") == "G501"
+        assert gl_core.canonical_rule("G501") == "G501"
+        assert "G305" in gl_core.rule_ids("G501")
+
+    def test_g305_alias_in_baseline_entries(self, tmp_path):
+        # a pre-migration baseline entry written under G305 still
+        # matches the G501 finding the scan now produces
+        path = str(tmp_path / "baseline.json")
+        with open(path, "w") as fh:
+            json.dump({"version": 1, "findings": [
+                {"rule": "G305", "file": "mmlspark_tpu/x.py",
+                 "symbol": "X.run", "count": 1, "why": "legacy"}]}, fh)
+        f = gl_core.Finding(rule="G501", path="mmlspark_tpu/x.py",
+                            line=3, message="m", symbol="X.run")
+        res = gl_core.apply_baseline([f], gl_core.load_baseline(path))
+        assert not res.new and len(res.baselined) == 1 and not res.stale
 
 
 # ------------------------------------------------------------------ G4
@@ -718,8 +822,12 @@ class TestRepoClean:
         assert not msgs, "\n".join(msgs)
 
     def test_rule_catalog_documents_every_reported_rule(self):
-        assert {"G101", "G201", "G301", "G401", "M001", "M002",
+        assert {"G101", "G201", "G301", "G401", "G501", "G502",
+                "G503", "G504", "M001", "M002",
                 "B001"} <= set(gl_core.RULE_DOCS)
+        # G305 is an alias now, not a documented rule of its own
+        assert "G305" not in gl_core.RULE_DOCS
+        assert gl_core.RULE_ALIASES == {"G305": "G501"}
 
 
 # ------------------------------------- regressions for fixed hazards

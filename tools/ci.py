@@ -5,7 +5,11 @@ Reference: the pipeline's style gate and sharded test matrix
 20-minute budgets and flaky-retry).  One command runs the same thing
 anywhere:
 
-    python tools/ci.py lint [--json]        # style gate + graftlint
+    python tools/ci.py lint [--json] [--full]
+                                            # style gate + graftlint
+                                            # (incremental --changed mode
+                                            # by default; --full scans
+                                            # the whole tree)
     python tools/ci.py metrics-lint         # M001/M002 alias (graftlint G3)
     python tools/ci.py perf-gate --fresh /tmp/bench_obs.json
                                             # bench regression gate
@@ -26,10 +30,16 @@ anywhere:
 Lint runs two layers with zero dependencies: a built-in AST style
 linter (syntax, unused imports, bare except, mutable default args —
 ruff replaces it when installed), then **graftlint**
-(tools/graftlint/, docs/static_analysis.md): jit-purity hazards (G1),
-lock discipline (G2), registry drift incl. the old metrics-lint
-M001/M002 (G3), and resource hygiene (G4), gated by the checked-in
-baseline tools/graftlint_baseline.json.
+(tools/graftlint/, docs/static_analysis.md): jit-purity hazards (G1,
+now tracked through the cross-module call graph), lock discipline
+(G2), registry drift incl. the old metrics-lint M001/M002 (G3),
+resource hygiene (G4), and SPMD/sharding hazards (G5 "shardlint":
+axis-literal hygiene, rule-table shadowing/coverage, use-after-donate),
+gated by the checked-in baseline tools/graftlint_baseline.json.
+graftlint runs in --changed mode (findings filtered to the git diff;
+automatic full scan when the analyzer or a registry surface changed)
+and always drops a SARIF 2.1.0 artifact (graftlint.sarif, override
+with GRAFTLINT_SARIF) for diff-annotation tooling.
 
 Sharding assigns test FILES round-robin over sorted order, so shard
 membership is deterministic across machines; a failed shard reruns once
@@ -182,18 +192,38 @@ def metrics_lint() -> int:
     return 1 if failures else 0
 
 
-def graftlint_lint(json_out: bool = False) -> int:
+def graftlint_lint(json_out: bool = False, changed_only: bool = True,
+                   sarif_out: str = None) -> int:
     """Run the full graftlint pass set against the checked-in baseline
     (tools/graftlint_baseline.json): any non-baselined finding — or a
-    stale baseline entry — fails."""
-    res = _graftlint.run_with_baseline(ROOT)
+    stale baseline entry — fails.
+
+    `changed_only` is graftlint's --changed incremental mode (the
+    default here): the whole tree is still analyzed — the cross-module
+    call graph is whole-program — but findings are reported for the
+    git-changed file set, falling back to the full report when the
+    analyzer or a registry surface changed.  `sarif_out` additionally
+    writes a SARIF 2.1.0 artifact (for diff annotation); the
+    GRAFTLINT_SARIF env var overrides the default path."""
+    res = _graftlint.run_with_baseline(ROOT, changed_only=changed_only)
     print(_gl_core.format_findings(res, json_out=json_out))
+    sarif_out = sarif_out or os.environ.get(
+        "GRAFTLINT_SARIF", os.path.join(ROOT, "graftlint.sarif"))
+    try:
+        with open(sarif_out, "w", encoding="utf-8") as f:
+            f.write(_gl_core.format_sarif(res))
+            f.write("\n")
+        print(f"graftlint: SARIF artifact -> "
+              f"{os.path.relpath(sarif_out, ROOT)}")
+    except OSError as e:
+        print(f"graftlint: could not write SARIF artifact: {e}")
     return 0 if not (res.new or res.stale) else 1
 
 
-def lint(json_out: bool = False) -> int:
+def lint(json_out: bool = False, full: bool = False) -> int:
     style_rc = _style_lint()
-    graft_rc = graftlint_lint(json_out=json_out)
+    graft_rc = graftlint_lint(json_out=json_out,
+                              changed_only=not full)
     return style_rc or graft_rc
 
 
@@ -437,9 +467,12 @@ def main(argv=None):
                     help="perf-gate: widen tolerance bands")
     ap.add_argument("--json", action="store_true",
                     help="lint: machine-readable graftlint output")
+    ap.add_argument("--full", action="store_true",
+                    help="lint: disable graftlint's --changed "
+                         "incremental mode (report the whole tree)")
     args = ap.parse_args(argv)
     if args.command == "lint":
-        return lint(json_out=args.json)
+        return lint(json_out=args.json, full=args.full)
     if args.command == "metrics-lint":
         return metrics_lint()
     if args.command == "perf-gate":

@@ -1,64 +1,97 @@
 """graftlint — AST-based hazard analyzer for the jax_graft tree.
 
-Four pass families over ``mmlspark_tpu/``, ``tools/``, ``examples/``:
+Five pass families over ``mmlspark_tpu/``, ``tools/``, ``examples/``:
 
-* G1 (g1_trace): jit-purity / tracer hazards reachable from trace roots
+* G1 (g1_trace): jit-purity / tracer hazards reachable from trace
+  roots, over the cross-module interprocedural call graph
+  (``core.ModuleGraph``)
 * G2 (g2_locks): ``#: guarded-by`` lock-discipline race detection
 * G3 (g3_registry): fault-point / metric / span / queue-telemetry drift
   (absorbs the old metrics-lint M001/M002, ids preserved)
 * G4 (g4_hygiene): thread naming + leak-check coverage, bounded queues,
   tmp+fsync+rename durable writes
+* G5 (g5_spmd): SPMD/sharding contract — axis-literal hygiene (G501,
+  absorbing G305), rule-table shadowing (G502) and coverage (G503),
+  use-after-donate (G504)
 
 Run ``python -m tools.graftlint --rules`` for the catalog, or see
 docs/static_analysis.md for the full workflow (suppressions, baseline
-ratchet, CI wiring via ``tools/ci.py lint``).
+ratchet, ``--changed`` incremental mode, ``--format=sarif``, CI wiring
+via ``tools/ci.py lint``).
 """
 from __future__ import annotations
 
 import os
 from typing import List, Optional, Sequence
 
-from .core import (BaselineResult, Finding, RULE_DOCS, DEFAULT_TARGETS,
-                   apply_baseline, baseline_key, collect_files,
-                   format_findings, load_baseline, write_baseline)
+from .core import (BaselineResult, Finding, ModuleGraph, RULE_ALIASES,
+                   RULE_DOCS, DEFAULT_TARGETS, apply_baseline,
+                   baseline_key, canonical_rule, changed_files,
+                   collect_files, format_findings, format_sarif,
+                   load_baseline, needs_full_scan, rule_ids,
+                   write_baseline)
 from .g1_trace import check_trace_purity
 from .g2_locks import check_lock_discipline
 from .g3_registry import check_registries
 from .g4_hygiene import check_hygiene
+from .g5_spmd import check_spmd
 
 __all__ = ["run", "run_with_baseline", "Finding", "BaselineResult",
-           "RULE_DOCS", "DEFAULT_TARGETS", "apply_baseline",
-           "baseline_key", "collect_files", "format_findings",
-           "load_baseline", "write_baseline", "default_baseline_path"]
+           "ModuleGraph", "RULE_DOCS", "RULE_ALIASES", "DEFAULT_TARGETS",
+           "apply_baseline", "baseline_key", "canonical_rule",
+           "changed_files", "collect_files", "format_findings",
+           "format_sarif", "load_baseline", "needs_full_scan",
+           "rule_ids", "write_baseline", "default_baseline_path"]
 
 
 def default_baseline_path(root: str) -> str:
     return os.path.join(root, "tools", "graftlint_baseline.json")
 
 
+def _rule_selected(rule: str, prefixes: Sequence[str]) -> bool:
+    """Prefix match over the rule's canonical id AND its aliases, so
+    --rules G305 (or the legacy G3 family filter) still selects G501."""
+    ids = rule_ids(rule)
+    return any(i.startswith(p) for i in ids for p in prefixes)
+
+
 def run(root: str,
         targets: Sequence[str] = DEFAULT_TARGETS,
         rules: Optional[Sequence[str]] = None) -> List[Finding]:
     """All findings (pre-baseline), sorted by location.  `rules`
-    filters to rule-id prefixes, e.g. ("G2", "M")."""
+    filters to rule-id prefixes, e.g. ("G2", "M"); aliases count, so
+    "G305" selects G501."""
     files = collect_files(root, targets)
+    graph = ModuleGraph([sf for sf in files if sf.tree is not None])
     findings: List[Finding] = []
-    findings += check_trace_purity(files)
+    findings += check_trace_purity(files, graph)
     findings += check_lock_discipline(files)
     findings += check_registries(files, root)
     findings += check_hygiene(files, root)
+    findings += check_spmd(files, root, graph)
     if rules:
-        findings = [f for f in findings
-                    if any(f.rule.startswith(r) for r in rules)]
+        findings = [f for f in findings if _rule_selected(f.rule, rules)]
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+def _filter_changed(findings: List[Finding],
+                    changed: set) -> List[Finding]:
+    return [f for f in findings if f.path in changed]
 
 
 def run_with_baseline(root: str,
                       targets: Sequence[str] = DEFAULT_TARGETS,
                       baseline_path: Optional[str] = None,
-                      rules: Optional[Sequence[str]] = None
-                      ) -> BaselineResult:
+                      rules: Optional[Sequence[str]] = None,
+                      changed_only: bool = False) -> BaselineResult:
+    """`changed_only` is the --changed incremental mode: the WHOLE tree
+    is still parsed (the cross-module graph and the registry passes are
+    whole-program), but findings and baseline entries are filtered to
+    the git-changed file set — CI on a small diff reports in that
+    diff's terms.  A change to the analyzer itself or to a registry
+    surface other files are checked against (core._FULL_SCAN_FILES)
+    falls back to the full report, as does any failure to ask git."""
     if baseline_path is None:
         baseline_path = default_baseline_path(root)
     findings = run(root, targets, rules=rules)
@@ -66,5 +99,11 @@ def run_with_baseline(root: str,
     if rules:
         prefixes = tuple(rules)
         baseline = {k: v for k, v in baseline.items()
-                    if k.split("::", 1)[0].startswith(prefixes)}
+                    if _rule_selected(k.split("::", 1)[0], prefixes)}
+    if changed_only:
+        changed = changed_files(root)
+        if not needs_full_scan(changed):
+            findings = _filter_changed(findings, changed)
+            baseline = {k: v for k, v in baseline.items()
+                        if k.split("::", 3)[1] in changed}
     return apply_baseline(findings, baseline)

@@ -1,5 +1,6 @@
-"""CLI: python -m tools.graftlint [paths...] [--json] [--baseline P]
-[--write-baseline] [--rules G1,G2,...] [--no-baseline]
+"""CLI: python -m tools.graftlint [paths...] [--json|--format=sarif]
+[--baseline P] [--write-baseline] [--rules G1,G2,...] [--no-baseline]
+[--changed]
 
 Exit status: 0 when clean (every finding baselined, no stale entries),
 1 otherwise — suitable for CI.
@@ -10,9 +11,11 @@ import argparse
 import os
 import sys
 
-from . import (DEFAULT_TARGETS, RULE_DOCS, apply_baseline,
-               default_baseline_path, format_findings, load_baseline,
-               run, write_baseline)
+from . import (DEFAULT_TARGETS, RULE_ALIASES, RULE_DOCS, apply_baseline,
+               changed_files, default_baseline_path, format_findings,
+               format_sarif, load_baseline, needs_full_scan, run,
+               write_baseline)
+from . import _rule_selected
 
 
 def main(argv=None) -> int:
@@ -20,7 +23,7 @@ def main(argv=None) -> int:
         prog="graftlint",
         description="AST hazard analyzer: jit purity (G1), lock "
                     "discipline (G2), registry drift (G3/M), resource "
-                    "hygiene (G4)")
+                    "hygiene (G4), SPMD/sharding contract (G5)")
     ap.add_argument("paths", nargs="*",
                     help=f"targets relative to --root "
                          f"(default: {' '.join(DEFAULT_TARGETS)})")
@@ -28,7 +31,12 @@ def main(argv=None) -> int:
                     help="repo root (default: two levels above this "
                          "package)")
     ap.add_argument("--json", action="store_true", dest="json_out",
-                    help="machine-readable output")
+                    help="machine-readable output (same as "
+                         "--format=json)")
+    ap.add_argument("--format", default=None, dest="fmt",
+                    choices=["text", "json", "sarif"],
+                    help="output format (sarif: SARIF 2.1.0 for diff "
+                         "annotation)")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON path (default: "
                          "tools/graftlint_baseline.json)")
@@ -39,7 +47,12 @@ def main(argv=None) -> int:
                          "and exit 0")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule-id prefixes to run "
-                         "(e.g. G2,M)")
+                         "(e.g. G2,M); aliases resolve (G305 -> G501)")
+    ap.add_argument("--changed", action="store_true",
+                    help="incremental mode: whole-program analysis, "
+                         "findings filtered to the git-changed file "
+                         "set (full report when the analyzer or a "
+                         "registry surface changed)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -47,6 +60,8 @@ def main(argv=None) -> int:
     if args.list_rules:
         for rule in sorted(RULE_DOCS):
             print(f"{rule}  {RULE_DOCS[rule]}")
+        for alias in sorted(RULE_ALIASES):
+            print(f"{alias}  alias of {RULE_ALIASES[alias]}")
         return 0
 
     root = args.root or os.path.dirname(os.path.dirname(
@@ -55,6 +70,7 @@ def main(argv=None) -> int:
     rules = tuple(r.strip() for r in args.rules.split(",")) \
         if args.rules else None
     baseline_path = args.baseline or default_baseline_path(root)
+    fmt = args.fmt or ("json" if args.json_out else "text")
 
     findings = run(root, targets, rules=rules)
 
@@ -67,9 +83,22 @@ def main(argv=None) -> int:
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
     if rules:
         baseline = {k: v for k, v in baseline.items()
-                    if k.split("::", 1)[0].startswith(tuple(rules))}
+                    if _rule_selected(k.split("::", 1)[0], rules)}
+    if args.changed:
+        changed = changed_files(root)
+        if needs_full_scan(changed):
+            print("graftlint: --changed fell back to a full scan "
+                  "(analyzer/registry surface changed or git "
+                  "unavailable)", file=sys.stderr)
+        else:
+            findings = [f for f in findings if f.path in changed]
+            baseline = {k: v for k, v in baseline.items()
+                        if k.split("::", 2)[1] in changed}
     res = apply_baseline(findings, baseline)
-    print(format_findings(res, json_out=args.json_out))
+    if fmt == "sarif":
+        print(format_sarif(res))
+    else:
+        print(format_findings(res, json_out=(fmt == "json")))
     return 0 if not (res.new or res.stale) else 1
 
 

@@ -8,31 +8,39 @@ the device), a lock is held for the trace's lifetime, and a host sync
 dispatch queue.  The PR 8 compile sentry catches the recompile
 symptom at runtime; this pass catches the cause before anything runs.
 
-Approach (per module — the hazards this repo has grown are all
-module-local closures handed to `jax.jit`):
+Approach (whole-program — hazards increasingly hide one import away
+from the `jax.jit` that traces them):
 
 1. index every function/method definition, including nested closures;
 2. mark **trace roots**: functions decorated with / passed to a trace
    wrapper (`jax.jit`, `pjit`, `shard_map`, `pallas_call`, `vmap`,
    `grad`, `value_and_grad`, `lax.scan/cond/while_loop/fori_loop`,
-   `pmap`, `remat`, `checkify`, ...);
-3. build intra-module call edges: direct calls by name, plus any
-   function reference passed as an argument (covers
-   ``value_and_grad(loss_fn)`` and scan bodies);
-4. flag hazard calls in every function reachable from a root.
+   `pmap`, `remat`, `checkify`, ...) — including references to traced
+   functions imported from another scanned module;
+3. build call edges: direct calls by local name, any function
+   reference passed as an argument (covers ``value_and_grad(loss_fn)``
+   and scan bodies), and — via ``core.ModuleGraph`` — calls that
+   resolve through the import tables into OTHER scanned modules
+   (``from ..ops import helper; helper(x)`` inside a jitted step walks
+   into ops' `helper`);
+4. flag hazard calls in every function reachable from a root,
+   reporting each in the file that contains it (suppressions apply
+   where the hazard lives, not where the trace root is).
 
 The analysis is deliberately name-based and conservative: dynamic
-dispatch (``self.fn(...)``, callables from parameters) creates no
-edges, so a hazard hidden behind one is missed — the price of zero
-false edges from host-side driver loops into the traced step they
-dispatch.
+dispatch (``self.fn(...)``, callables from parameters, ``getattr``)
+creates no edges, so a hazard hidden behind one is missed — the price
+of zero false edges from host-side driver loops into the traced step
+they dispatch.  A call that is itself flagged as a hazard (e.g.
+``telemetry.incr``) is a boundary: the graph does not also descend
+into the telemetry implementation.
 """
 from __future__ import annotations
 
 import ast
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .core import Finding, SourceFile
+from .core import Finding, ModuleGraph, SourceFile
 
 __all__ = ["check_trace_purity", "trace_roots"]
 
@@ -143,20 +151,48 @@ def trace_roots(sf: SourceFile, idx: _ModuleIndex) -> Set[ast.AST]:
     return roots
 
 
-def _call_edges(fn: ast.AST, idx: _ModuleIndex) -> Set[ast.AST]:
-    """Callees of `fn`: direct calls by local name, plus function
-    references passed as arguments (higher-order: grad/scan bodies)."""
-    out: Set[ast.AST] = set()
+_Node = Tuple[SourceFile, ast.AST]
+
+
+def _resolved_fn(graph: Optional[ModuleGraph], sf: SourceFile,
+                 dotted: str) -> Optional[_Node]:
+    """(file, def) when `dotted` statically resolves to a top-level
+    function in another scanned module."""
+    if graph is None:
+        return None
+    hit = graph.resolve(sf, dotted)
+    if hit is None:
+        return None
+    target_sf, node, _mod = hit
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return (target_sf, node)
+    return None
+
+
+def _call_edges(sf: SourceFile, fn: ast.AST, idx: _ModuleIndex,
+                graph: Optional[ModuleGraph]) -> Set[_Node]:
+    """Callees of `fn`: direct calls by local name, function references
+    passed as arguments (higher-order: grad/scan bodies), and calls
+    resolving through the import tables into other scanned modules.
+    Hazard calls are boundaries — flagged at the call site, not
+    descended into."""
+    out: Set[_Node] = set()
     for node in ast.walk(fn):
         if not isinstance(node, ast.Call):
             continue
         names = set(_fn_args_of_call(node))
-        if isinstance(node.func, ast.Name):
-            names.add(node.func.id)
+        dotted = _dotted(node.func)
+        if dotted is not None and _hazard(node, idx) is None:
+            names.add(dotted)
         for name in names:
-            for callee in idx.functions.get(name, ()):
-                if callee is not fn:
-                    out.add(callee)
+            if "." not in name and name in idx.functions:
+                for callee in idx.functions[name]:
+                    if callee is not fn:
+                        out.add((sf, callee))
+                continue
+            hit = _resolved_fn(graph, sf, name)
+            if hit is not None and hit[1] is not fn:
+                out.add(hit)
     return out
 
 
@@ -245,26 +281,55 @@ def _scan_fn(sf: SourceFile, fn: ast.AST, idx: _ModuleIndex,
         stack.extend(ast.iter_child_nodes(node))
 
 
-def check_trace_purity(files: Sequence[SourceFile]) -> List[Finding]:
-    findings: List[Finding] = []
-    for sf in files:
-        if sf.tree is None:
+def _imported_roots(sf: SourceFile, idx: _ModuleIndex,
+                    graph: Optional[ModuleGraph]) -> Set[_Node]:
+    """Functions defined in OTHER scanned modules but handed to a trace
+    wrapper here: ``jax.jit(imported_step)``."""
+    roots: Set[_Node] = set()
+    if graph is None:
+        return roots
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and _is_trace_wrapper(node.func, idx)):
             continue
+        for name in _fn_args_of_call(node):
+            if name in idx.functions:
+                continue  # local — trace_roots already has it
+            hit = _resolved_fn(graph, sf, name)
+            if hit is not None:
+                roots.add(hit)
+    return roots
+
+
+def check_trace_purity(files: Sequence[SourceFile],
+                       graph: Optional[ModuleGraph] = None
+                       ) -> List[Finding]:
+    files = [sf for sf in files if sf.tree is not None]
+    if graph is None:
+        graph = ModuleGraph(files)
+    idxs: Dict[SourceFile, _ModuleIndex] = {}
+    for sf in files:
         idx = _ModuleIndex()
         idx.visit(sf.tree)
-        roots = trace_roots(sf, idx)
-        if not roots:
-            continue
-        # BFS over intra-module call edges
-        reachable: Set[ast.AST] = set(roots)
-        frontier = list(roots)
-        while frontier:
-            fn = frontier.pop()
-            for callee in _call_edges(fn, idx):
-                if callee not in reachable:
-                    reachable.add(callee)
-                    frontier.append(callee)
-        seen_lines: Set[int] = set()
-        for fn in reachable:
-            _scan_fn(sf, fn, idx, findings, seen_lines)
+        idxs[sf] = idx
+    roots: Set[_Node] = set()
+    for sf in files:
+        idx = idxs[sf]
+        roots.update((sf, fn) for fn in trace_roots(sf, idx))
+        roots.update(_imported_roots(sf, idx, graph))
+    # BFS over the interprocedural call graph
+    reachable: Set[_Node] = set(roots)
+    frontier = list(roots)
+    while frontier:
+        sf, fn = frontier.pop()
+        for callee in _call_edges(sf, fn, idxs[sf], graph):
+            if callee not in reachable and callee[0] in idxs:
+                reachable.add(callee)
+                frontier.append(callee)
+    findings: List[Finding] = []
+    seen_lines: Dict[SourceFile, Set[int]] = {}
+    for sf, fn in sorted(reachable,
+                         key=lambda n: (n[0].rel, n[1].lineno)):
+        _scan_fn(sf, fn, idxs[sf], findings,
+                 seen_lines.setdefault(sf, set()))
     return findings

@@ -32,12 +32,11 @@ violate at runtime:
   decision; it must expose depth or shed telemetry (a metric literal
   containing ``queue`` or ``shed``) or the first production stall is
   invisible.
-* **G305 — PartitionSpec axis names ↔ MESH_AXIS_NAMES.**  Every string
-  axis literal inside a ``P(...)``/``PartitionSpec(...)`` call must be a
-  declared mesh axis (``parallel/mesh.py:MESH_AXIS_NAMES``).  A typo'd
-  axis name does not error anywhere at runtime — GSPMD silently
-  replicates the leaf, the collective never materializes, and the only
-  symptom is MFU quietly dying.
+* **G305 → G501.**  The PartitionSpec axis-hygiene check grew into the
+  G5 SPMD family (``g5_spmd``, docs/static_analysis.md) as G501; the
+  old id survives as an alias (``core.RULE_ALIASES``) so existing
+  suppressions and baseline entries keep resolving.
+  ``declared_mesh_axes`` is re-exported here for compatibility.
 * **G405 — registered flow stages declare budget + metrics.**  Every
   ``core.flow.Stage`` subclass is a named, registered hop in the
   graftflow runtime; it must pin a bounded class-level credit budget
@@ -409,73 +408,10 @@ def _queue_telemetry_findings(files: Sequence[SourceFile]
 
 
 # ------------------------------------------------ mesh-axis hygiene
+# Moved to g5_spmd (G305 -> G501); re-exported for the callers that
+# grew up importing it from here.
 
-_MESH_REL = "mmlspark_tpu/parallel/mesh.py"
-
-
-def declared_mesh_axes(root: str) -> Set[str]:
-    """MESH_AXIS_NAMES parsed out of parallel/mesh.py's tuple literal
-    (AST, not import — same no-jax rule as the metrics tables)."""
-    path = os.path.join(root, "mmlspark_tpu", "parallel", "mesh.py")
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        if any(isinstance(t, ast.Name) and t.id == "MESH_AXIS_NAMES"
-               for t in node.targets) and isinstance(node.value,
-                                                     (ast.Tuple, ast.List)):
-            return {e.value for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)}
-    raise RuntimeError("MESH_AXIS_NAMES tuple literal not found in "
-                       f"{_MESH_REL}")
-
-
-def _spec_axis_findings(files: Sequence[SourceFile],
-                        root: str) -> List[Finding]:
-    """G305: every string axis literal in a P()/PartitionSpec() call must
-    be a declared mesh axis."""
-    try:
-        axes = declared_mesh_axes(root)
-    except (OSError, RuntimeError, SyntaxError) as e:
-        return [Finding(
-            rule="G305", path=_MESH_REL, line=0, symbol="MESH_AXIS_NAMES",
-            message=f"could not parse MESH_AXIS_NAMES: {e}",
-            hint="keep it a plain tuple literal of string constants")]
-    findings: List[Finding] = []
-    for sf in files:
-        # gate on the name actually appearing — most files have no specs
-        if sf.tree is None or "PartitionSpec" not in sf.src:
-            continue
-        for node in ast.walk(sf.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            tail = f.attr if isinstance(f, ast.Attribute) else (
-                f.id if isinstance(f, ast.Name) else "")
-            if tail not in ("P", "PartitionSpec"):
-                continue
-            lits: List[ast.Constant] = []
-            for arg in node.args:
-                elts = arg.elts if isinstance(arg,
-                                              (ast.Tuple, ast.List)) else [arg]
-                lits.extend(e for e in elts
-                            if isinstance(e, ast.Constant)
-                            and isinstance(e.value, str))
-            for lit in lits:
-                if lit.value in axes:
-                    continue
-                line = lit.lineno
-                if not sf.suppressed("G305", line):
-                    findings.append(sf.finding(
-                        "G305", line,
-                        f"PartitionSpec axis {lit.value!r} is not a "
-                        f"declared mesh axis ({_MESH_REL}:"
-                        f"MESH_AXIS_NAMES = {tuple(sorted(axes))})",
-                        hint="a typo'd axis silently REPLICATES the "
-                             "leaf — fix the name or declare the axis"))
-    return findings
+from .g5_spmd import declared_mesh_axes  # noqa: E402,F401
 
 
 # ------------------------------------------- flow-stage registration
@@ -560,6 +496,5 @@ def check_registries(files: Sequence[SourceFile], root: str
     findings += metric_findings(files, declared)
     findings += _span_findings(files)
     findings += _queue_telemetry_findings(files)
-    findings += _spec_axis_findings(files, root)
     findings += _stage_findings(files, declared)
     return findings

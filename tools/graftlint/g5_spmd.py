@@ -1,0 +1,795 @@
+"""G5 "shardlint": SPMD/sharding static analysis.
+
+PR 17 made partition-rule tables, the 3D ``MeshPlan``, donated carry
+buffers, and ``axis_name``-keyed collectives the backbone of the
+trainer.  None of those contracts errors loudly when violated: a typo'd
+axis silently replicates the leaf, a shadowed regex rule silently never
+fires, a missing rule raises only when a real tree reaches
+``shard_params``, and a read of a donated buffer returns whatever XLA
+reused the memory for.  Each is a chip-hours soak to find at runtime
+and a few milliseconds to find from the AST:
+
+* **G501 — SPMD axis literals ↔ MESH_AXIS_NAMES** (absorbs G305, id
+  kept as an alias).  Every string axis literal inside a
+  ``P(...)``/``PartitionSpec(...)`` call — which is how axes reach
+  ``pjit`` in/out_shardings, ``shard_map`` in/out_specs and
+  ``NamedSharding`` — and every ``axis_name=``/``axis=`` literal on a
+  ``lax`` collective (``psum``/``pmean``/``pmax``/``all_gather``/
+  ``ppermute``/``axis_index``/...) must be declared in
+  ``parallel/mesh.py:MESH_AXIS_NAMES`` *or* bound by an enclosing
+  mesh context in the same file (a ``pmap(..., axis_name="i")`` or a
+  literal ``Mesh(..., axis_names=(...))`` — the only two ways this
+  repo introduces non-mesh axes).
+* **G502 — rule-table shadowing.**  Rule tables are first-match-wins
+  (``sharding_rules.spec_for``); a literal table entry whose regex is
+  subsumed by an earlier entry is unreachable dead weight — and usually
+  a "my new rule never fired" bug.  Subsumption is decided by bounded
+  sample enumeration of the later regex (every generated match of the
+  later pattern also matches the earlier one); patterns the enumerator
+  can't expand (lookaround, backrefs) are skipped, never guessed.
+* **G503 — rule-table coverage.**  ``spec_for`` raises on a leaf no
+  rule matches.  The lint-time twin: every path in
+  ``sharding_rules.PARAM_PATH_MANIFEST`` must match some rule in every
+  literal table, and every subtree key a ``*params_to_*`` pytree
+  builder emits must have a manifest entry — so adding a param to the
+  model forces the manifest row, and the manifest row forces table
+  coverage, before a chip ever sees the tree.
+* **G504 — use-after-donate.**  A buffer passed in a donated position
+  of a ``jax.jit(..., donate_argnums=/donate_argnames=)`` wrapper is
+  dead after the call; reading it again is undefined (XLA may have
+  aliased the output into its memory).  The safe idiom is rebinding
+  (``state = step(state)``).  Flagged: a later read of a donated
+  name in the same scope, and donating inside a loop without
+  rebinding (the next iteration passes a dead buffer back in).
+  Wrapper discovery is interprocedural via ``core.ModuleGraph``;
+  dynamic wrappers (``**kw`` donate args, factory returns) create no
+  call edges — conservative, zero false edges.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleGraph, SourceFile
+
+try:  # py >= 3.11
+    from re import _parser as _sre  # type: ignore[attr-defined]
+except ImportError:  # py <= 3.10
+    import sre_parse as _sre  # type: ignore[no-redef]
+
+__all__ = ["check_spmd", "declared_mesh_axes", "manifest_param_paths",
+           "literal_rule_tables", "regex_subsumes"]
+
+_MESH_REL = "mmlspark_tpu/parallel/mesh.py"
+_RULES_REL = "mmlspark_tpu/parallel/sharding_rules.py"
+
+# lax collectives that consume an axis name; value-first ones take it
+# as positional arg 1, the index/size queries as positional arg 0
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                "ppermute", "all_to_all", "psum_scatter", "pbroadcast",
+                "pshuffle", "axis_index", "axis_size"}
+_AXIS_ARG0 = {"axis_index", "axis_size"}
+
+
+def _tail(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _str_consts(node: ast.AST) -> List[ast.Constant]:
+    """String constants in a literal (bare or tuple/list of)."""
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [e for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+
+
+# --------------------------------------------------- G501: axis hygiene
+
+def declared_mesh_axes(root: str) -> Set[str]:
+    """MESH_AXIS_NAMES parsed out of parallel/mesh.py's tuple literal
+    (AST, not import — same no-jax rule as the metrics tables)."""
+    path = os.path.join(root, "mmlspark_tpu", "parallel", "mesh.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if any(isinstance(t, ast.Name) and t.id == "MESH_AXIS_NAMES"
+               for t in node.targets) and isinstance(node.value,
+                                                     (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    raise RuntimeError("MESH_AXIS_NAMES tuple literal not found in "
+                       f"{_MESH_REL}")
+
+
+def _locally_bound_axes(sf: SourceFile) -> Set[str]:
+    """Axis names a file introduces OUTSIDE the global mesh: a
+    ``pmap(..., axis_name="i")`` binds its name for the mapped body; a
+    literal ``Mesh(..., axis_names=(...))`` declares its own axes."""
+    bound: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(node.func)
+        if tail == "pmap":
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    bound.update(c.value for c in _str_consts(kw.value))
+        elif tail == "Mesh":
+            cands = [kw.value for kw in node.keywords
+                     if kw.arg == "axis_names"]
+            if len(node.args) > 1:
+                cands.append(node.args[1])
+            for c in cands:
+                bound.update(s.value for s in _str_consts(c))
+    return bound
+
+
+def _jaxish(sf: SourceFile, graph: Optional[ModuleGraph],
+            dotted: str) -> bool:
+    """Is this dotted callable plausibly a jax/lax entry point?  Head
+    must be a jax-ish module (alias source containing 'jax'), or the
+    bare name must be imported from one — mirrors g1's wrapper gate so
+    an unrelated `psum` method never trips the rule."""
+    head = dotted.split(".", 1)[0]
+    src = graph.source_module(sf, head) if graph else ""
+    if "." in dotted:
+        return head in ("jax", "lax") or "jax" in src
+    return "jax" in src
+
+
+def _collective_axis_findings(sf: SourceFile, axes: Set[str],
+                              graph: Optional[ModuleGraph]
+                              ) -> List[Finding]:
+    findings: List[Finding] = []
+    bound = _locally_bound_axes(sf)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        tail = _tail(node.func)
+        if (tail not in _COLLECTIVES or dotted is None
+                or not _jaxish(sf, graph, dotted)):
+            continue
+        lits: List[ast.Constant] = []
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                lits.extend(_str_consts(kw.value))
+        pos = 0 if tail in _AXIS_ARG0 else 1
+        if len(node.args) > pos:
+            lits.extend(_str_consts(node.args[pos]))
+        for lit in lits:
+            if lit.value in axes or lit.value in bound:
+                continue
+            if not sf.suppressed("G501", lit.lineno):
+                findings.append(sf.finding(
+                    "G501", lit.lineno,
+                    f"collective {tail}() names axis {lit.value!r}, "
+                    f"which is neither a declared mesh axis "
+                    f"({_MESH_REL}:MESH_AXIS_NAMES = "
+                    f"{tuple(sorted(axes))}) nor bound by a local "
+                    f"pmap/Mesh in this file",
+                    hint="an unknown axis_name fails only when the "
+                         "collective is traced under the mesh — fix "
+                         "the name or declare the axis"))
+    return findings
+
+
+def _spec_axis_findings(files: Sequence[SourceFile], root: str,
+                        graph: Optional[ModuleGraph] = None
+                        ) -> List[Finding]:
+    """G501 (né G305): every string axis literal in a
+    P()/PartitionSpec() call, and every collective axis_name literal,
+    must be a declared (or locally bound) mesh axis."""
+    try:
+        axes = declared_mesh_axes(root)
+    except (OSError, RuntimeError, SyntaxError) as e:
+        return [Finding(
+            rule="G501", path=_MESH_REL, line=0, symbol="MESH_AXIS_NAMES",
+            message=f"could not parse MESH_AXIS_NAMES: {e}",
+            hint="keep it a plain tuple literal of string constants")]
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        # gate on the names actually appearing — most files have neither
+        if "PartitionSpec" in sf.src:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _tail(node.func) not in ("P", "PartitionSpec"):
+                    continue
+                lits: List[ast.Constant] = []
+                for arg in node.args:
+                    lits.extend(_str_consts(arg))
+                for lit in lits:
+                    if lit.value in axes:
+                        continue
+                    if not sf.suppressed("G501", lit.lineno):
+                        findings.append(sf.finding(
+                            "G501", lit.lineno,
+                            f"PartitionSpec axis {lit.value!r} is not a "
+                            f"declared mesh axis ({_MESH_REL}:"
+                            f"MESH_AXIS_NAMES = {tuple(sorted(axes))})",
+                            hint="a typo'd axis silently REPLICATES the "
+                                 "leaf — fix the name or declare the "
+                                 "axis"))
+        if any(c in sf.src for c in _COLLECTIVES):
+            findings.extend(_collective_axis_findings(sf, axes, graph))
+    return findings
+
+
+# ------------------------------------------- G502: rule-table shadowing
+
+class _Bail(Exception):
+    """Regex construct the sample enumerator doesn't model."""
+
+
+def _in_chars(av, cap: int = 3) -> List[str]:
+    """Representative characters for an IN (character-class) op."""
+    negated = False
+    excluded: Set[str] = set()
+    chars: List[str] = []
+    for op, arg in av:
+        name = getattr(op, "name", str(op))
+        if name == "NEGATE":
+            negated = True
+        elif name == "LITERAL":
+            chars.append(chr(arg))
+            excluded.add(chr(arg))
+        elif name == "RANGE":
+            lo, hi = arg
+            chars.extend({chr(lo), chr(hi)})
+            excluded.update(chr(c) for c in range(lo, min(hi + 1,
+                                                          lo + 128)))
+        elif name == "CATEGORY":
+            cat = getattr(arg, "name", str(arg))
+            pick = {"CATEGORY_DIGIT": "0", "CATEGORY_WORD": "a",
+                    "CATEGORY_SPACE": " ", "CATEGORY_NOT_DIGIT": "a",
+                    "CATEGORY_NOT_WORD": "/", "CATEGORY_NOT_SPACE": "a",
+                    }.get(cat)
+            if pick is None:
+                raise _Bail(cat)
+            chars.append(pick)
+            excluded.add(pick)
+        else:
+            raise _Bail(name)
+    if negated:
+        for probe in "az09_/-. %":
+            if probe not in excluded:
+                return [probe]
+        raise _Bail("NEGATE")
+    return chars[:cap]
+
+
+def _expand(ops, cap: int = 32) -> List[str]:
+    """Bounded enumeration of strings matching a parsed regex."""
+    outs = [""]
+    for op, av in ops:
+        name = getattr(op, "name", str(op))
+        if name == "LITERAL":
+            outs = [o + chr(av) for o in outs]
+        elif name == "NOT_LITERAL":
+            ch = "a" if av != ord("a") else "b"
+            outs = [o + ch for o in outs]
+        elif name == "ANY":
+            outs = [o + "a" for o in outs]
+        elif name == "IN":
+            outs = [o + c for o in outs for c in _in_chars(av)][:cap]
+        elif name == "BRANCH":
+            subs: List[str] = []
+            for branch in av[1]:
+                subs.extend(_expand(list(branch), cap))
+            outs = [o + s for o in outs for s in subs[:cap]][:cap]
+        elif name == "SUBPATTERN":
+            subs = _expand(list(av[-1]), cap)
+            outs = [o + s for o in outs for s in subs][:cap]
+        elif name in ("MAX_REPEAT", "MIN_REPEAT"):
+            lo, hi, sub = av
+            counts = [lo]
+            hi_n = hi if isinstance(hi, int) and hi < 1 << 16 else lo + 1
+            if hi_n > lo:
+                counts.append(lo + 1)
+            subs = _expand(list(sub), cap) or [""]
+            reps = [s * n for n in counts for s in subs[:cap]]
+            outs = [o + r for o in outs for r in reps][:cap]
+        elif name == "AT":
+            continue  # anchors constrain position, not content
+        else:
+            raise _Bail(name)
+        if not outs:
+            return []
+    return outs[:cap]
+
+
+def _regex_samples(pattern: str, cap: int = 32) -> Optional[List[str]]:
+    """Strings guaranteed to match `pattern`, or None when the pattern
+    uses constructs the enumerator doesn't model (lookaround,
+    backrefs) — callers must then skip, not guess."""
+    try:
+        ops = _sre.parse(pattern)
+        rx = re.compile(pattern)
+    except Exception:
+        return None
+    try:
+        cands = _expand(list(ops), cap)
+    except (_Bail, RecursionError, ValueError):
+        return None
+    samples = [s for s in cands if rx.search(s)]
+    return samples or None
+
+
+def regex_subsumes(earlier: str, later: str) -> bool:
+    """True when every enumerable match of `later` (plus padded
+    variants that still match it — anchors filter themselves) also
+    matches `earlier`, i.e. the later first-match-wins entry can never
+    fire.  Undecidable patterns return False (no finding)."""
+    try:
+        rx_e, rx_l = re.compile(earlier), re.compile(later)
+    except re.error:
+        return False
+    samples = _regex_samples(later)
+    if samples is None:
+        return False
+    variants: List[str] = []
+    for s in samples:
+        variants.append(s)
+        for v in ("x" + s, s + "x", "x" + s + "x",
+                  "pre/" + s, s + "/post"):
+            if rx_l.search(v):
+                variants.append(v)
+    return all(rx_e.search(v) for v in variants[:256])
+
+
+def literal_rule_tables(sf: SourceFile
+                        ) -> List[Tuple[ast.AST,
+                                        List[Tuple[str, int]]]]:
+    """Literal RuleTables in a file: every Tuple/List whose elements
+    are all 2-tuples of (string constant, P()/PartitionSpec() call),
+    with at least two rows.  Returns (table node, [(pattern, lineno)])."""
+    out: List[Tuple[ast.AST, List[Tuple[str, int]]]] = []
+    if sf.tree is None or "PartitionSpec" not in sf.src:
+        return out
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Tuple, ast.List)) \
+                or len(node.elts) < 2:
+            continue
+        rows: List[Tuple[str, int]] = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Tuple) and len(e.elts) == 2):
+                break
+            pat, spec = e.elts
+            if not (isinstance(pat, ast.Constant)
+                    and isinstance(pat.value, str)
+                    and isinstance(spec, ast.Call)
+                    and _tail(spec.func) in ("P", "PartitionSpec")):
+                break
+            rows.append((pat.value, pat.lineno))
+        else:
+            out.append((node, rows))
+    return out
+
+
+def _shadow_findings(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        for _table, rows in literal_rule_tables(sf):
+            for j in range(1, len(rows)):
+                pat_j, line_j = rows[j]
+                for i in range(j):
+                    pat_i, line_i = rows[i]
+                    if not regex_subsumes(pat_i, pat_j):
+                        continue
+                    if not sf.suppressed("G502", line_j):
+                        findings.append(sf.finding(
+                            "G502", line_j,
+                            f"rule {pat_j!r} is unreachable: every "
+                            f"path it matches is already claimed by "
+                            f"{pat_i!r} (line {line_i}, tables are "
+                            f"first-match-wins)",
+                            hint="move the specific rule above the "
+                                 "general one, or delete the dead row"))
+                    break  # one shadow report per row
+    return findings
+
+
+# -------------------------------------------- G503: rule-table coverage
+
+def manifest_param_paths(root: str) -> Tuple[str, ...]:
+    """PARAM_PATH_MANIFEST parsed out of sharding_rules.py's tuple
+    literal (AST, no jax import)."""
+    path = os.path.join(root, "mmlspark_tpu", "parallel",
+                        "sharding_rules.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # NAME: Tuple[...] = (...)
+            targets = [node.target]
+        else:
+            continue
+        if any(isinstance(t, ast.Name) and t.id == "PARAM_PATH_MANIFEST"
+               for t in targets) and isinstance(node.value,
+                                                (ast.Tuple, ast.List)):
+            return tuple(e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    raise RuntimeError("PARAM_PATH_MANIFEST tuple literal not found in "
+                       f"{_RULES_REL}")
+
+
+def _builder_prefixes(fn: ast.AST) -> List[Tuple[str, int]]:
+    """Constant-keyed subtree prefixes a ``*params_to_*`` builder's
+    returned dict literal commits to: ``{"embed": ..., "out":
+    {"ln_f": ...}}`` -> [("embed", ln), ("out/ln_f", ln), ...].
+    Dynamic values (stacked trees, comprehensions) stop recursion —
+    they are exactly what the manifest exists to cover."""
+    out: List[Tuple[str, int]] = []
+
+    def visit_dict(d: ast.Dict, prefix: str) -> None:
+        for k, v in zip(d.keys, d.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            path = f"{prefix}/{k.value}" if prefix else k.value
+            if isinstance(v, ast.Dict):
+                visit_dict(v, path)
+            else:
+                out.append((path, k.lineno))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value,
+                                                       ast.Dict):
+            visit_dict(node.value, "")
+    return out
+
+
+def _coverage_findings(files: Sequence[SourceFile],
+                       root: str) -> List[Finding]:
+    try:
+        manifest = manifest_param_paths(root)
+    except (OSError, RuntimeError, SyntaxError) as e:
+        return [Finding(
+            rule="G503", path=_RULES_REL, line=0,
+            symbol="PARAM_PATH_MANIFEST",
+            message=f"could not parse PARAM_PATH_MANIFEST: {e}",
+            hint="keep it a plain tuple literal of string constants")]
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        # (a) every builder-committed subtree has a manifest entry
+        if sf.rel.startswith("mmlspark_tpu/"):
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                        and "params_to_" in node.name):
+                    continue
+                for prefix, line in _builder_prefixes(node):
+                    if any(m == prefix or m.startswith(prefix + "/")
+                           for m in manifest):
+                        continue
+                    if not sf.suppressed("G503", line):
+                        findings.append(sf.finding(
+                            "G503", line,
+                            f"pytree builder {node.name}() emits subtree "
+                            f"{prefix!r} with no PARAM_PATH_MANIFEST "
+                            f"entry ({_RULES_REL})",
+                            hint="add representative leaf paths so "
+                                 "rule-table coverage stays checkable"))
+        # (b) every manifest path matches some rule in every table
+        for table, rows in literal_rule_tables(sf):
+            uncovered = []
+            for m in manifest:
+                if not any(_safe_search(pat, m) for pat, _ in rows):
+                    uncovered.append(m)
+            for m in uncovered[:3]:  # one table, few messages
+                line = rows[0][1]
+                if not sf.suppressed("G503", line):
+                    findings.append(sf.finding(
+                        "G503", line,
+                        f"rule table has no rule matching manifest "
+                        f"path {m!r} — shard_params would raise on a "
+                        f"real tree",
+                        hint='close the table with a (".*", P()) '
+                             "catch-all when replication is intended"))
+    return findings
+
+
+def _safe_search(pattern: str, name: str) -> bool:
+    try:
+        return re.search(pattern, name) is not None
+    except re.error:
+        return True  # unparseable pattern: not this rule's problem
+
+
+# --------------------------------------------- G504: use-after-donate
+
+_DonateInfo = Tuple[frozenset, frozenset, int]  # positions, names, line
+
+
+def _donate_kw(call: ast.Call) -> Optional[Tuple[frozenset, frozenset]]:
+    """(positions, argnames) when `call` carries a non-empty LITERAL
+    donate_argnums/donate_argnames.  Dynamic values (``(0,) if donate
+    else ()``) return None — conservative skip."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for e in elts:
+            if not isinstance(e, ast.Constant):
+                return None
+            if kw.arg == "donate_argnums" and isinstance(e.value, int):
+                nums.add(e.value)
+            elif kw.arg == "donate_argnames" \
+                    and isinstance(e.value, str):
+                names.add(e.value)
+            else:
+                return None
+    if not nums and not names:
+        return None
+    return frozenset(nums), frozenset(names)
+
+
+def _donating_jit_call(node: ast.AST) -> Optional[Tuple[frozenset,
+                                                        frozenset]]:
+    """Donate info when `node` is (or wraps, e.g. under
+    ``watch_compiles(jax.jit(...))``) a jit/pjit call with literal
+    donate args."""
+    for call in ast.walk(node):
+        if isinstance(call, ast.Call) and _tail(call.func) in ("jit",
+                                                               "pjit"):
+            info = _donate_kw(call)
+            if info is not None:
+                return info
+    return None
+
+
+def _donating_wrappers(sf: SourceFile) -> Dict[str, _DonateInfo]:
+    """Top-level names in `sf` bound to a donating jit: module-level
+    ``name = jax.jit(fn, donate_argnums=...)`` assignments (possibly
+    wrapped in telemetry decorator calls) and ``@partial(jax.jit,
+    donate_argnums=...)``-decorated defs."""
+    out: Dict[str, _DonateInfo] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            info = _donating_jit_call(node.value)
+            if info is not None:
+                out[node.targets[0].id] = info + (node.lineno,)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                is_jit = _tail(dec.func) in ("jit", "pjit")
+                is_partial_jit = (_tail(dec.func) == "partial"
+                                  and dec.args
+                                  and _tail(dec.args[0]) in ("jit",
+                                                             "pjit"))
+                if is_jit or is_partial_jit:
+                    info = _donate_kw(dec)
+                    if info is not None:
+                        out[node.name] = info + (node.lineno,)
+    return out
+
+
+def _wrapper_at_call(call: ast.Call, sf: SourceFile,
+                     tables: Dict[str, Dict[str, _DonateInfo]],
+                     graph: Optional[ModuleGraph]
+                     ) -> Optional[_DonateInfo]:
+    """Donate info for a call site, resolving bare local names,
+    from-imports, and one-level module-attribute calls."""
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    mod = graph.module_of.get(sf) if graph else None
+    if "." not in d:
+        local = tables.get(mod or "", {}).get(d)
+        if local is not None:
+            return local
+        if graph is None or mod is None:
+            return None
+        fb = graph.from_binding(sf, d)
+        if fb is not None:
+            return tables.get(fb[0], {}).get(fb[1])
+        return None
+    head, _, rest = d.partition(".")
+    if "." in rest or graph is None:
+        return None
+    target = graph.alias_target(sf, head)
+    if target is not None:
+        return tables.get(target, {}).get(rest)
+    return None
+
+
+def _scope_bodies(sf: SourceFile):
+    """(scope node, body) for the module and every def — each analyzed
+    independently (closures sharing state across scopes are dynamic
+    dispatch territory, deliberately out)."""
+    yield sf.tree, sf.tree.body
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body
+
+
+def _stmts_with_loops(body, depth: int = 0):
+    """Statements of one scope in source order, tagged with enclosing
+    loop depth; nested defs/classes are separate scopes and skipped."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield stmt, depth
+        for attr, extra in (("body", 1), ("orelse", 0)) \
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)) \
+                else (("body", 0), ("orelse", 0), ("finalbody", 0)):
+            yield from _stmts_with_loops(getattr(stmt, attr, []) or [],
+                                         depth + extra)
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from _stmts_with_loops(h.body, depth)
+
+
+def _calls_in_stmt(stmt: ast.stmt) -> List[ast.Call]:
+    """Calls in the statement's OWN expressions — nested statement
+    bodies excluded, so every call is analyzed exactly once, at its
+    innermost statement (where rebinding targets are visible)."""
+    stack: List[ast.AST] = []
+    for _field, value in ast.iter_fields(stmt):
+        for v in value if isinstance(value, list) else [value]:
+            if isinstance(v, ast.expr):
+                stack.append(v)
+            elif isinstance(v, ast.withitem):
+                stack.append(v.context_expr)
+    out: List[ast.Call] = []
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Lambda,)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _name_events(scope: ast.AST) -> List[Tuple[int, int, bool, str]]:
+    """(lineno, col, is_store, id) for every Name in the scope, nested
+    defs excluded."""
+    events: List[Tuple[int, int, bool, str]] = []
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Name):
+            events.append((node.lineno, node.col_offset,
+                           isinstance(node.ctx, (ast.Store, ast.Del)),
+                           node.id))
+        stack.extend(ast.iter_child_nodes(node))
+    events.sort()
+    return events
+
+
+def _target_names(stmt: ast.stmt) -> Set[str]:
+    names: Set[str] = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+    return names
+
+
+def _donation_findings(files: Sequence[SourceFile],
+                       graph: Optional[ModuleGraph]) -> List[Finding]:
+    tables: Dict[str, Dict[str, _DonateInfo]] = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        mod = graph.module_of.get(sf) if graph else None
+        wrappers = _donating_wrappers(sf)
+        if mod is not None and wrappers:
+            tables[mod] = wrappers
+    if not tables:
+        return []
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for scope, body in _scope_bodies(sf):
+            events = None  # lazy: most scopes have no donating calls
+            for stmt, loop_depth in _stmts_with_loops(body):
+                for call in _calls_in_stmt(stmt):
+                    info = _wrapper_at_call(call, sf, tables, graph)
+                    if info is None:
+                        continue
+                    nums, argnames, def_line = info
+                    donated: Set[str] = set()
+                    for i in sorted(nums):
+                        if i < len(call.args) \
+                                and isinstance(call.args[i], ast.Name):
+                            donated.add(call.args[i].id)
+                    for kw in call.keywords:
+                        if kw.arg in argnames \
+                                and isinstance(kw.value, ast.Name):
+                            donated.add(kw.value.id)
+                    dead = donated - _target_names(stmt)
+                    if not dead:
+                        continue
+                    if loop_depth > 0:
+                        for var in sorted(dead):
+                            if not sf.suppressed("G504", call.lineno):
+                                findings.append(sf.finding(
+                                    "G504", call.lineno,
+                                    f"{var!r} is donated to the jit "
+                                    f"defined at line {def_line} inside "
+                                    f"a loop without being rebound — "
+                                    f"the next iteration passes a dead "
+                                    f"buffer",
+                                    hint="rebind the carry: x = "
+                                         "step(x, ...)"))
+                        continue
+                    if events is None:
+                        events = _name_events(scope)
+                    after = (getattr(call, "end_lineno", call.lineno),
+                             getattr(call, "end_col_offset", 0))
+                    for var in sorted(dead):
+                        for ln, col, is_store, name in events:
+                            if name != var or (ln, col) <= after:
+                                continue
+                            if is_store:
+                                break  # rebound first — later reads ok
+                            if not sf.suppressed("G504", ln):
+                                findings.append(sf.finding(
+                                    "G504", ln,
+                                    f"{var!r} was donated to the jit "
+                                    f"defined at line {def_line} (call "
+                                    f"at line {call.lineno}) and is "
+                                    f"read again here — XLA may have "
+                                    f"reused its buffer",
+                                    hint="use the call's result, or "
+                                         "drop the donate arg for this "
+                                         "path"))
+                            break
+    return findings
+
+
+# ----------------------------------------------------------------- entry
+
+def check_spmd(files: Sequence[SourceFile], root: str,
+               graph: Optional[ModuleGraph] = None) -> List[Finding]:
+    live = [sf for sf in files if sf.tree is not None]
+    if graph is None:
+        graph = ModuleGraph(live)
+    findings = _spec_axis_findings(live, root, graph)
+    findings += _shadow_findings(live)
+    findings += _coverage_findings(live, root)
+    findings += _donation_findings(live, graph)
+    return findings
