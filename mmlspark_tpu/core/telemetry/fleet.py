@@ -49,6 +49,7 @@ from .exposition import sanitize_name
 
 __all__ = [
     "parse_hist_key", "merge_histogram_snapshots", "merge_snapshots",
+    "federate_host_snapshots",
     "hist_total", "cum_le", "render_fleet_prometheus", "stitch_spans",
     "SLO", "SLOEngine", "default_slos", "FlightRecorder",
 ]
@@ -195,6 +196,30 @@ def merge_snapshots(sources: Mapping[str, Mapping[str, Any]],
         "histograms": histograms,
         "histograms_by_replica": hists_by,
     }
+
+
+def federate_host_snapshots(paths: Mapping[str, Any],
+                            versions: Optional[Mapping[str, str]] = None
+                            ) -> Dict[str, Any]:
+    """`merge_snapshots` over per-HOST snapshot files: ``paths`` maps a
+    host id to a JSON file holding that process's ``export_snapshot()``
+    dict — the payload a `parallel.distributed.HostTelemetryServer`
+    serves at ``/metrics.json`` and tools/dist_soak.py scrapes to disk.
+    A missing/torn file drops that host from the view (its ``replicas``
+    entry records ``"unreadable": True``) rather than failing the merge:
+    a dead host must not take the pod's observability down with it."""
+    sources: Dict[str, Mapping[str, Any]] = {}
+    unreadable: List[str] = []
+    for host_id, path in paths.items():
+        try:
+            with open(os.fspath(path)) as f:
+                sources[str(host_id)] = json.load(f)
+        except (OSError, ValueError):
+            unreadable.append(str(host_id))
+    merged = merge_snapshots(sources, versions)
+    for host_id in unreadable:
+        merged["replicas"][host_id] = {"unreadable": True}
+    return merged
 
 
 def hist_total(merged: Mapping[str, Any], name: str) -> Dict[str, Any]:

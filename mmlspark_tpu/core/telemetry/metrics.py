@@ -98,6 +98,16 @@ DECLARED_METRICS: Dict[str, str] = {
     "slo.alert.resolved": "counter",      # + .<slo> variants
     "autoscale.up": "counter",
     "autoscale.down": "counter",
+    # -- counters: elastic multi-host runtime (parallel/distributed.py, PR 19)
+    "dist.rendezvous.attempt": "counter",   # one per join attempt
+    "dist.rendezvous.retry": "counter",     # backed-off re-attempts
+    "dist.rendezvous.failed": "counter",    # deadline/budget exhausted
+    "dist.heartbeat.missed": "counter",     # dropped beats (injected/lost)
+    "dist.host.lost": "counter",            # + .<host> variants
+    "dist.membership.update": "counter",    # published epoch advances
+    "dist.membership.stale": "counter",     # rejected stale epochs
+    "dist.barrier.timeout": "counter",
+    "dist.collective.overrun": "counter",   # hang-budget deadline fired
     # -- histograms
     "serving.request.latency": "histogram",
     "serving.batch.fill": "histogram",
@@ -115,6 +125,7 @@ DECLARED_METRICS: Dict[str, str] = {
     "serving.fleet.request.latency": "histogram",   # gateway e2e, labeled
     "serving.fleet.replica.latency": "histogram",   # labeled {replica=...}
     "fleet.scrape.latency": "histogram",    # one full federated pull+merge
+    "dist.rendezvous.latency": "histogram",  # join time, per host
     # -- gauges
     "serving.queue.depth": "gauge",
     "serving.batcher.queue_depth": "gauge",
@@ -141,6 +152,8 @@ DECLARED_METRICS: Dict[str, str] = {
     "fleet.pull.replicas": "gauge",       # replicas reached by last pull
     "slo.burn_rate": "gauge",             # + .<slo> variants
     "autoscale.target_replicas": "gauge",
+    "dist.membership.epoch": "gauge",     # current membership epoch
+    "dist.membership.hosts": "gauge",     # live hosts in the view
 }
 
 
@@ -198,6 +211,7 @@ HISTOGRAM_FAMILY: Dict[str, str] = {
     "serving.fleet.request.latency": "latency",
     "serving.fleet.replica.latency": "latency",
     "fleet.scrape.latency": "latency",
+    "dist.rendezvous.latency": "latency",
 }
 
 

@@ -92,7 +92,7 @@ from ..utils.sync import make_lock
 
 __all__ = ["DeviceFeed", "H2DStage", "FeedTelemetry", "FEED_TELEMETRY",
            "default_depth", "FeedSource", "FEED_END", "FEED_FAULT_POINTS",
-           "load_tuned"]
+           "load_tuned", "host_local_feed"]
 
 # every fault point the feed engine can cross — chaos_soak enumerates
 # this alongside flow_fault_points() so its full-coverage plan can never
@@ -213,6 +213,23 @@ def load_tuned() -> Dict[str, Any]:
                 cfg = {}
             _TUNED_CACHE[path] = cfg
         return cfg
+
+
+def host_local_feed(model: int = 1, seq: int = 1, **kwargs) -> "DeviceFeed":
+    """A DeviceFeed over THIS host's addressable chips.  On a
+    multi-process mesh every host feeds only the devices it can address
+    (``jax.local_devices()``), each process running its own transfer
+    rings and shard_put pool against its own chips — the per-host half
+    of the elastic runtime (parallel/distributed.py); the sharded path
+    underneath is already per-host by construction
+    (`addressable_shard_layout` maps addressable shards only).
+    Single-process this is exactly ``DeviceFeed(mesh=make_mesh())``."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(model=model, seq=seq, devices=jax.local_devices())
+    return DeviceFeed(mesh=mesh, **kwargs)
 
 
 class FeedTelemetry:

@@ -366,6 +366,12 @@ class CheckpointManager:
         corrupt step with its (possibly quarantined) directory path —
         the TrainingGuard records it in its own ledger there.
 
+        This is also the rollback floor for elastic resume: because
+        :meth:`restore` hands back uncommitted host-numpy leaves, the
+        returned state re-shards cleanly onto a mesh REBUILT from the
+        surviving hosts after a host loss — the same checkpoint serves
+        the 8-device and the shrunken 6-device geometry unchanged.
+
         Catches Exception only — an InjectedCrash (BaseException) still
         kills the process, as a real SIGKILL would."""
         steps = self.all_steps()

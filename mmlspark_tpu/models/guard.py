@@ -131,6 +131,10 @@ class TrainingGuard:
         self.rollbacks = 0
         self.lr_scale = 1.0
         self.anomalies: List[Dict] = []
+        # peers declared dead by the elastic runtime (PR 19): each loss
+        # is one ledgered record — it rides quarantine.json so a
+        # post-mortem can line the mesh shrink up against the rollbacks
+        self.lost_hosts: List[Dict] = []
 
         # watchdog heartbeat: a monotonically increasing step sequence
         # plus a begin timestamp; the reported-latch keeps one hung step
@@ -295,6 +299,27 @@ class TrainingGuard:
                       action=GuardAction.ROLLBACK)
         return GuardAction.ROLLBACK
 
+    def host_lost(self, host_id: str,
+                  record: Optional[Dict] = None) -> str:
+        """A peer host was declared dead (heartbeat-lease expiry or an
+        injected ``training.host_lost`` fault).  The model did nothing
+        wrong, so this does NOT consume the rollback budget or back off
+        the learning rate — it ledgers the loss (``lost_hosts``, persisted
+        in quarantine.json) and tells the loop to run the same
+        checkpoint-floor rollback it would for a poisoned batch, after
+        which the elastic runtime rebuilds the mesh over the survivors
+        (docs/robustness.md "Elastic multi-host")."""
+        rec = {"host_id": str(host_id)}
+        rec.update(record or {})
+        self.lost_hosts.append(rec)
+        self.anomalies.append({"kind": "host_lost",
+                               "action": GuardAction.ROLLBACK, **rec})
+        core_telemetry.incr("training.anomaly")
+        core_telemetry.incr("training.anomaly.host_lost")
+        with core_telemetry.span("training.guard.anomaly") as sp:
+            sp.attrs.update({"kind": "host_lost", **rec})
+        return GuardAction.ROLLBACK
+
     # ------------------------------------------------- quarantine I/O
 
     def quarantine_checkpoint(self, step, path) -> None:
@@ -314,7 +339,8 @@ class TrainingGuard:
                for b in self.quarantined]
         doc = {"quarantined": sorted(ids, key=repr),
                "quarantined_checkpoints": sorted(
-                   [s, p] for s, p in self.quarantined_checkpoints)}
+                   [s, p] for s, p in self.quarantined_checkpoints),
+               "lost_hosts": list(self.lost_hosts)}
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
@@ -341,3 +367,7 @@ class TrainingGuard:
                 self.quarantined_checkpoints.add((int(s), str(p)))
             except (TypeError, ValueError):
                 continue
+        # pre-PR-19 docs carry no host ledger: absent key is legacy
+        for rec in doc.get("lost_hosts", []):
+            if isinstance(rec, dict) and rec not in self.lost_hosts:
+                self.lost_hosts.append(rec)

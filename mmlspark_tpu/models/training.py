@@ -282,7 +282,8 @@ def lm_params_from_3d(params3d, num_layers: int):
 
 
 def make_lm_train_step_3d(model, optimizer, plan, remat: bool = True,
-                          donate: bool = True):
+                          donate: bool = True,
+                          hang_budget_s: Optional[float] = None):
     """``step(params3d, opt_state, tokens) -> (params3d, opt_state,
     metrics)`` on a :class:`~mmlspark_tpu.parallel.mesh.MeshPlan`'s 3D
     mesh: data-parallel microbatches x megatron tensor rules x the GPipe
@@ -306,7 +307,16 @@ def make_lm_train_step_3d(model, optimizer, plan, remat: bool = True,
     per-microbatch means equals the global mean and numerics match the
     single-device reference).  MoE aux losses are NOT folded in on this
     path yet.  Metrics carry loss + grad_norm — the TrainingGuard's
-    probe pair."""
+    probe pair.
+
+    ``hang_budget_s`` bounds each step's collective entry with
+    `parallel.distributed.run_with_deadline` (blocking until ready
+    inside the budget): on a multi-host mesh a dead peer wedges the
+    allreduce, and the budget turns that into a
+    :class:`~mmlspark_tpu.parallel.distributed.CollectiveTimeout`
+    instead of a silent stall — pair it with
+    ``TrainingGuard.hang_budget_s()`` so the p95-derived watchdog model
+    and the hard deadline agree."""
     import flax.linen as nn
 
     from ..parallel.pipeline import gpipe_spmd_apply
@@ -386,11 +396,23 @@ def make_lm_train_step_3d(model, optimizer, plan, remat: bool = True,
             "loss": lsum / a, "grad_norm": optax.global_norm(grads)}
 
     tok_sh = NamedSharding(mesh, P(None, None, "data", None))
-    return core_telemetry.watch_compiles(jax.jit(
+    jitted = core_telemetry.watch_compiles(jax.jit(
         step,
         in_shardings=(None, None, tok_sh),
         donate_argnums=(0, 1) if donate else (),
     ), name="training.lm_train_step_3d")
+    if hang_budget_s is None:
+        return jitted
+
+    from ..parallel.distributed import run_with_deadline
+
+    def guarded_step(params3d, opt_state, tokens):
+        return run_with_deadline(
+            lambda: jax.block_until_ready(
+                jitted(params3d, opt_state, tokens)),
+            hang_budget_s, name="lm_train_step_3d")
+
+    return guarded_step
 
 
 def make_lm_resumable_step_3d(model, optimizer, plan,
@@ -595,6 +617,7 @@ def fit_epochs_resumable(
     log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
     guard=None,
     step_factory: Optional[Callable[[float], Callable]] = None,
+    elastic=None,
 ) -> Tuple[TrainState, Dict[str, float]]:
     """fit_epochs that survives being killed: auto-checkpoints every
     `checkpoint_every` steps through CheckpointManager and, on the next
@@ -635,12 +658,32 @@ def fit_epochs_resumable(
     gradient probe deterministically for chaos tests
     (tools/train_soak.py).
 
+    With an :class:`~mmlspark_tpu.parallel.distributed.ElasticContext`
+    passed as ``elastic``, the loop runs in multi-host mode: every step
+    it beats this host's heartbeat lease and polls for peer loss
+    (lease expiry detected by the coordinator's monitor, epoch adoption
+    on followers, or an injected ``training.host_lost`` fault), and the
+    step itself executes under a hang budget
+    (``elastic.hang_budget_s``, else the guard's p95-derived
+    ``hang_budget_s()``) so a dead peer's wedged allreduce raises
+    ``CollectiveTimeout`` instead of stalling.  A detected loss runs the
+    quarantine → shrink → resume ladder: ``guard.host_lost`` ledgers the
+    peer into quarantine.json, the state rolls back to the newest
+    verified checkpoint (per-shard crc re-verification; the restored
+    leaves are host arrays, so they re-shard onto ANY mesh), the
+    membership epoch advances (``elastic.commit_loss``), and
+    ``elastic.rebuild(view)`` may hand back ``(mesh, step_fn)`` built
+    over the survivors — the shrunken data axis — after which the
+    schedule replays from the checkpoint floor with batches re-sharded
+    onto the new mesh (docs/robustness.md "Elastic multi-host").
+
     Telemetry: ``training.autosave`` per checkpoint written (best-effort:
     a failed write warns + counts ``checkpoint.write_failed`` instead of
     killing the run), ``training.resume`` when a run starts from a
     restored step, plus the guard's ``training.anomaly/quarantine/
     rollback/abort/hang`` ledger."""
     from ..io.feed import DeviceFeed
+    from ..parallel.distributed import run_with_deadline
     from ..utils.faults import InjectedFault, fault_point
     # lazy: checkpoint.py imports TrainState from this module
     from .checkpoint import CheckpointManager
@@ -707,6 +750,44 @@ def fit_epochs_resumable(
         order = None
         order_epoch = -1
         while g < total:
+            lost = elastic.poll() if elastic is not None else None
+            if lost:
+                # the elastic ladder: ledger the dead peers, roll back to
+                # the checkpoint floor, advance the membership epoch,
+                # rebuild the mesh over the survivors, replay
+                view = elastic.commit_loss(lost)
+                if guard is not None:
+                    for h in lost:
+                        guard.host_lost(h, {"epoch": view.epoch,
+                                            "schedule_step": int(g)})
+                    guard.save_quarantine(qpath)
+                with core_telemetry.span("training.elastic.shrink") as sp:
+                    try:
+                        state, g = mgr.restore_verified(
+                            template=state, on_corrupt=_on_corrupt,
+                            quarantine=True)
+                    except FileNotFoundError as e:
+                        core_telemetry.incr("training.abort")
+                        raise TrainingAborted(
+                            f"host loss {lost} at schedule step {g} "
+                            f"found no verifiable checkpoint: {e}") from e
+                    sp.attrs["lost"] = ",".join(lost)
+                    sp.attrs["epoch"] = view.epoch
+                    sp.attrs["restored_step"] = g
+                rebuilt = elastic.rebuild(view)
+                if rebuilt is not None:
+                    mesh, step_fn = rebuilt
+                    dp = mesh.shape["data"]
+                    if batch_size % dp != 0:
+                        raise ValueError(
+                            f"batch_size {batch_size} not divisible by "
+                            f"surviving data-parallel degree {dp} "
+                            f"(epoch {view.epoch})")
+                    feed = DeviceFeed(mesh=mesh)
+                    img_sh = batch_sharding(mesh, np.ndim(images))
+                    lbl_sh = batch_sharding(mesh, np.ndim(labels))
+                core_telemetry.incr("training.resume")
+                continue
             epoch, b = divmod(g, steps_per_epoch)
             if epoch != order_epoch:
                 # schedule is (seed, epoch)-pure: resume regenerates it
@@ -739,13 +820,27 @@ def fit_epochs_resumable(
                 xb = np.full_like(xb, np.nan)
             dbi, dbl = feed.put_group([xb, yb],
                                       shardings=(img_sh, lbl_sh))
+            def _exec(st=state, xi=dbi, yi=dbl):
+                ns, m = step_fn(st, xi, yi)
+                # float() forces the sync, so execution (collectives
+                # included) lands inside the deadline below, not after
+                return ns, {k: float(v) for k, v in m.items()}
+
             t0 = time.perf_counter()
             with core_telemetry.span("training.step"):
                 if guard is not None:
                     guard.step_begin(g)
                 try:
-                    new_state, m = step_fn(state, dbi, dbl)
-                    metrics = {k: float(v) for k, v in m.items()}
+                    if elastic is not None:
+                        # multi-host mode: a dead peer wedges the
+                        # allreduce — bound every collective entry
+                        budget = elastic.hang_budget_s
+                        if budget is None and guard is not None:
+                            budget = guard.hang_budget_s()
+                        new_state, metrics = run_with_deadline(
+                            _exec, budget, name="training.step")
+                    else:
+                        new_state, metrics = _exec()
                 finally:
                     if guard is not None:
                         guard.step_end()

@@ -4,11 +4,28 @@ from .mesh import (
     MeshPlan,
     batch_sharding,
     default_mesh,
+    host_device_groups,
     make_mesh,
     replicated_sharding,
     shard_batch,
 )
-from .distributed import initialize_distributed, barrier
+from .distributed import (
+    DIST_FAULT_POINTS,
+    CollectiveTimeout,
+    ElasticContext,
+    HeartbeatMonitor,
+    HostInfo,
+    HostTelemetryServer,
+    MembershipStore,
+    MembershipView,
+    RendezvousError,
+    StaleMembershipError,
+    barrier,
+    initialize_distributed,
+    is_coordinator,
+    local_host_info,
+    run_with_deadline,
+)
 from .sharding_rules import (
     PARAM_PATH_MANIFEST,
     match_partition_rules,

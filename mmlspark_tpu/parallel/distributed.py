@@ -1,63 +1,911 @@
-"""Multi-host rendezvous + barrier: the control-plane replacement for the
-reference's driver socket handshakes.
+"""Elastic multi-host runtime: rendezvous, membership, host-death detection.
 
-Reference: lightgbm/LightGBMBase.scala:392-430 (createDriverNodesThread:
-ServerSocket rendezvous collecting host:port from every task) and
-vw/VowpalWabbitBase.scala:434-462 (spanning-tree daemon) — on TPU both are
-replaced by `jax.distributed.initialize` against the coordination service;
-data-plane AllReduce is XLA collectives over ICI/DCN, not TCP rings.
+The control-plane replacement for the reference's driver socket handshakes
+(lightgbm/LightGBMBase.scala:392-430 createDriverNodesThread: ServerSocket
+rendezvous collecting host:port from every task; vw/VowpalWabbitBase.scala:
+434-462 spanning-tree daemon).  On TPU the data plane is XLA collectives
+over ICI/DCN, not TCP rings — what remains OURS to build is everything the
+reference's driver did around the ring:
+
+* **Rendezvous** — `initialize_distributed` joins the jax coordination
+  service with bounded retries, full-jitter backoff and a hard deadline,
+  every attempt crossing the `dist.rendezvous` fault point.  A "already
+  initialized" runtime (standard on Cloud TPU VMs) is detected precisely,
+  not by substring accident.
+* **Membership** — an epoch-numbered view of the pod (host id, process
+  index, addressable device count) persisted by the coordinator through
+  :class:`MembershipStore` with the durable tmp+fsync+rename idiom.  A
+  view can only advance: publishing a stale epoch raises
+  :class:`StaleMembershipError` (and counts ``dist.membership.stale``).
+  The store doubles as a file-based rendezvous/heartbeat plane for
+  backends whose coordination service cannot host one (the CPU soak's
+  "gloo/proxy" stand-in) — the same API a TPU pod drives over the real
+  coordination service.
+* **Host-death detection** — :class:`HeartbeatMonitor`, a lease monitor
+  (clock-injectable, so tests script lease expiry under a
+  ``VirtualClock``) that declares a silent peer lost EXACTLY once:
+  ``dist.host.lost`` counter + record, instead of the loss being
+  discovered by a wedged allreduce.  Beats cross the ``dist.heartbeat``
+  fault point; an injected drop is a lost heartbeat message
+  (``dist.heartbeat.missed``), not an error.
+* **Hang-budget collectives** — `run_with_deadline` bounds every
+  collective entry (`barrier`, the elastic trainer's step) by a wall
+  budget, turning a silent wedge into a :class:`CollectiveTimeout`.
+* **Elasticity** — :class:`ElasticContext`, the per-step harness
+  `fit_epochs_resumable` polls: beat own lease, detect/adopt peer loss
+  (coordinator detects via the monitor; followers adopt the coordinator's
+  shrunken epoch), and rebuild the mesh over the survivors.  The
+  ``training.host_lost`` fault point injects a simulated peer death so
+  chaos plans exercise the whole quarantine → shrink → resume ladder.
+* **Per-host observability** — :class:`HostTelemetryServer`, a minimal
+  ``/metrics.json`` + ``/health`` endpoint serving this host's
+  ``export_snapshot`` in exactly the wire format the PR 15 federation
+  (`core/telemetry/fleet.py` ``merge_snapshots``) merges, so
+  ``/fleet/metrics`` shows the pod, not the process.
+
+Registry notes: fault points are rows in docs/robustness.md (graftlint
+G301/G302); counters/gauges are declared in
+core/telemetry/metrics.py ``DECLARED_METRICS`` (metrics-lint M001).
 """
 from __future__ import annotations
 
+import inspect
+import json
 import os
-from typing import Optional
+import random
+import re
+import socket
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["initialize_distributed", "barrier", "is_coordinator"]
+from ..core import telemetry as core_telemetry
+from ..utils.faults import InjectedFault, fault_point, monotonic, sleep
+from ..utils.sync import make_lock
+
+__all__ = [
+    "initialize_distributed",
+    "barrier",
+    "is_coordinator",
+    "reset_distributed_state",
+    "run_with_deadline",
+    "RendezvousError",
+    "StaleMembershipError",
+    "CollectiveTimeout",
+    "HostInfo",
+    "local_host_info",
+    "MembershipView",
+    "MembershipStore",
+    "HeartbeatMonitor",
+    "ElasticContext",
+    "HostTelemetryServer",
+    "DIST_FAULT_POINTS",
+]
+
+# the programmatic registry tools/chaos_soak.py --dist arms (mirrors
+# flow_fault_points(): a new point added here is covered automatically,
+# and the soak's stale-config check fails if a scripted point vanishes)
+DIST_FAULT_POINTS = ("dist.rendezvous", "dist.heartbeat",
+                     "training.host_lost")
+
+
+class RendezvousError(RuntimeError):
+    """Joining the multi-host job failed past the retry/deadline budget."""
+
+
+class StaleMembershipError(ValueError):
+    """A membership view with a non-advancing epoch was published or
+    required — acting on it would resurrect a dead host's devices."""
+
+
+class CollectiveTimeout(TimeoutError):
+    """A collective entry exceeded its hang budget.  The underlying XLA
+    call cannot be cancelled (the worker thread is abandoned as daemon);
+    this makes the wedge a loud, typed event instead of slow training."""
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous: hardened jax.distributed.initialize
+# ---------------------------------------------------------------------------
+
+# precise already-initialized detection: the runtime's message is
+# "Distributed system is already initialized" — matching any "already"
+# substring (the old behavior) swallowed e.g. deadline errors too
+_ALREADY_INITIALIZED = re.compile(r"already\s+initial", re.IGNORECASE)
 
 _INITIALIZED = {"done": False}
+
+
+def reset_distributed_state() -> None:
+    """Test seam: forget the module-level initialized latch (the real
+    jax runtime state, if any, is NOT torn down)."""
+    _INITIALIZED["done"] = False
 
 
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    max_attempts: int = 3,
+    backoff_s: float = 0.5,
+    timeout_s: float = 120.0,
+    seed: int = 0,
+    _initialize: Optional[Callable] = None,
 ) -> None:
-    """Join the multi-host job.  No-ops for single-process jobs and when the
-    TPU runtime already auto-initialized (standard on Cloud TPU VMs).
+    """Join the multi-host job.  No-ops for single-process jobs and when
+    the TPU runtime already auto-initialized (standard on Cloud TPU VMs).
     Env fallbacks: COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID.
+
+    Each attempt crosses the ``dist.rendezvous`` fault point; transient
+    failures retry up to `max_attempts` times with full-jitter backoff
+    (``uniform(0, backoff_s * 2**attempt)``) through the injectable
+    `utils.faults` clock, all under one hard `timeout_s` deadline.  The
+    remaining deadline is also passed to the runtime as its per-attempt
+    ``initialization_timeout`` when supported.  Counters:
+    ``dist.rendezvous.attempt`` / ``.retry`` / ``.failed`` and the
+    ``dist.rendezvous.latency`` histogram on success.
+
+    `_initialize` is the test seam replacing ``jax.distributed.initialize``.
     """
     if _INITIALIZED["done"]:
         return
-    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    coordinator_address = (coordinator_address
+                           or os.environ.get("COORDINATOR_ADDRESS"))
     if coordinator_address is None:
         # single host — note: do NOT touch jax.process_count() before this
         # point; it would initialize the local backend and make a later
         # jax.distributed.initialize impossible
         _INITIALIZED["done"] = True
         return
+    init = _initialize if _initialize is not None \
+        else jax.distributed.initialize
+    kwargs = dict(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes
+                          or os.environ.get("NUM_PROCESSES", 1)),
+        process_id=int(process_id if process_id is not None
+                       else os.environ.get("PROCESS_ID", 0)),
+    )
+    takes_timeout = False
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=int(num_processes or os.environ.get("NUM_PROCESSES", 1)),
-            process_id=int(process_id if process_id is not None else os.environ.get("PROCESS_ID", 0)),
-        )
-    except RuntimeError as e:
-        if "already" not in str(e).lower():  # runtime auto-initialized is fine
-            raise
-    _INITIALIZED["done"] = True
+        takes_timeout = ("initialization_timeout"
+                         in inspect.signature(init).parameters)
+    except (TypeError, ValueError):
+        pass
+    rng = random.Random(f"{seed}:dist.rendezvous")
+    deadline = monotonic() + float(timeout_s)
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, int(max_attempts))):
+        core_telemetry.incr("dist.rendezvous.attempt")
+        t0 = monotonic()
+        try:
+            fault_point("dist.rendezvous")
+            if takes_timeout:
+                remaining = max(1.0, deadline - monotonic())
+                init(initialization_timeout=int(remaining), **kwargs)
+            else:
+                init(**kwargs)
+            core_telemetry.histogram("dist.rendezvous.latency").observe(
+                monotonic() - t0)
+            _INITIALIZED["done"] = True
+            return
+        except RuntimeError as e:
+            if _ALREADY_INITIALIZED.search(str(e)):
+                # the runtime auto-initialized: joined, not failed
+                _INITIALIZED["done"] = True
+                return
+            last = e
+        except (InjectedFault, OSError) as e:
+            last = e
+        if attempt + 1 >= max(1, int(max_attempts)):
+            break
+        delay = rng.uniform(0.0, float(backoff_s) * (2.0 ** attempt))
+        if monotonic() + delay >= deadline:
+            break
+        core_telemetry.incr("dist.rendezvous.retry")
+        sleep(delay)
+    core_telemetry.incr("dist.rendezvous.failed")
+    raise RendezvousError(
+        f"rendezvous with {coordinator_address} failed after "
+        f"{max_attempts} attempts / {timeout_s:.0f}s deadline: "
+        f"{last!r}") from last
 
 
-def barrier(name: str = "barrier") -> None:
+# ---------------------------------------------------------------------------
+# Hang-budget collectives
+# ---------------------------------------------------------------------------
+
+def run_with_deadline(fn: Callable, budget_s: Optional[float],
+                      name: str = "collective"):
+    """Run `fn()` under a wall-clock hang budget.  `budget_s=None` runs
+    inline.  On overrun, counts ``dist.collective.overrun`` and raises
+    :class:`CollectiveTimeout`; the worker thread is abandoned (daemon) —
+    a wedged XLA collective cannot be cancelled, only *detected*, which
+    is exactly the property a host death must have (docs/robustness.md
+    "Elastic multi-host")."""
+    if budget_s is None:
+        return fn()
+    box: Dict[str, object] = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"dist-deadline-{name}")
+    t.start()
+    if not done.wait(timeout=float(budget_s)):
+        core_telemetry.incr("dist.collective.overrun")
+        raise CollectiveTimeout(
+            f"{name} exceeded its {float(budget_s):g}s hang budget")
+    if "error" in box:
+        raise box["error"]  # type: ignore[misc]
+    return box.get("result")
+
+
+def barrier(name: str = "barrier",
+            timeout_s: Optional[float] = 60.0) -> None:
     """Gang-sync all hosts (BarrierTaskContext.barrier() analog,
-    lightgbm/TrainUtils.scala:259-266).  A tiny psum across all devices forces
-    a global collective, which only completes when every host participates."""
-    x = jax.numpy.ones((jax.local_device_count(),))
-    out = jax.pmap(lambda v: jax.lax.psum(v, axis_name="i"), axis_name="i")(x)
-    np.asarray(out)  # block
+    lightgbm/TrainUtils.scala:259-266).  A tiny psum across all devices
+    forces a global collective, which only completes when every host
+    participates — now bounded by `timeout_s` (counts
+    ``dist.barrier.timeout`` and raises :class:`CollectiveTimeout`
+    instead of blocking forever on a dead peer)."""
+
+    def _sync():
+        x = jax.numpy.ones((jax.local_device_count(),))
+        out = jax.pmap(lambda v: jax.lax.psum(v, axis_name="i"),
+                       axis_name="i")(x)
+        np.asarray(out)  # block
+
+    try:
+        run_with_deadline(_sync, timeout_s, name=f"barrier.{name}")
+    except CollectiveTimeout:
+        core_telemetry.incr("dist.barrier.timeout")
+        raise
 
 
 def is_coordinator() -> bool:
     return jax.process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# Membership: epoch-numbered views of the pod
+# ---------------------------------------------------------------------------
+
+class HostInfo:
+    """One host's identity in a membership view: id, process index, and
+    its addressable device count (the data-axis capacity it brings)."""
+
+    __slots__ = ("host_id", "process_index", "num_devices", "address")
+
+    def __init__(self, host_id: str, process_index: int,
+                 num_devices: int, address: str = ""):
+        self.host_id = str(host_id)
+        self.process_index = int(process_index)
+        self.num_devices = int(num_devices)
+        self.address = str(address)
+
+    def to_dict(self) -> dict:
+        return {"host_id": self.host_id,
+                "process_index": self.process_index,
+                "num_devices": self.num_devices,
+                "address": self.address}
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "HostInfo":
+        return cls(doc["host_id"], doc["process_index"],
+                   doc["num_devices"], doc.get("address", ""))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, HostInfo)
+                and self.to_dict() == other.to_dict())
+
+    def __repr__(self) -> str:
+        return (f"HostInfo({self.host_id!r}, rank={self.process_index}, "
+                f"devices={self.num_devices})")
+
+
+def local_host_info(host_id: Optional[str] = None) -> HostInfo:
+    """This process's HostInfo (id defaults to ``host-<process_index>``).
+    Touches the backend — call only after `initialize_distributed`."""
+    rank = jax.process_index()
+    return HostInfo(
+        host_id if host_id is not None else f"host-{rank}",
+        rank, jax.local_device_count(),
+        address=socket.gethostname())
+
+
+class MembershipView:
+    """One epoch of pod membership.  Epochs only advance: every shrink
+    (or join) is a NEW view, so survivors can reject decisions made
+    against a stale roster (:meth:`require_epoch`)."""
+
+    def __init__(self, epoch: int, hosts: Sequence[HostInfo]):
+        if int(epoch) < 1:
+            raise ValueError(f"membership epochs start at 1, got {epoch}")
+        self.epoch = int(epoch)
+        self.hosts: List[HostInfo] = sorted(
+            hosts, key=lambda h: (h.process_index, h.host_id))
+        if len({h.host_id for h in self.hosts}) != len(self.hosts):
+            raise ValueError("duplicate host ids in membership view")
+
+    @property
+    def host_ids(self) -> List[str]:
+        return [h.host_id for h in self.hosts]
+
+    @property
+    def total_devices(self) -> int:
+        return sum(h.num_devices for h in self.hosts)
+
+    def data_axis(self, model: int = 1, pipe: int = 1) -> int:
+        """The data-parallel degree a `MeshPlan(data=-1, model, pipe)`
+        over this view's devices would absorb."""
+        n = self.total_devices
+        if n % (model * pipe) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by model*pipe={model * pipe}")
+        return n // (model * pipe)
+
+    def without(self, *lost_ids: str) -> "MembershipView":
+        """The next epoch minus `lost_ids` (the shrink-and-resume view)."""
+        gone = set(lost_ids)
+        missing = gone - set(self.host_ids)
+        if missing:
+            raise KeyError(f"hosts not in epoch {self.epoch}: "
+                           f"{sorted(missing)}")
+        survivors = [h for h in self.hosts if h.host_id not in gone]
+        if not survivors:
+            raise ValueError("cannot shrink to an empty membership view")
+        return MembershipView(self.epoch + 1, survivors)
+
+    def require_epoch(self, expected: int) -> None:
+        """Raise :class:`StaleMembershipError` unless this view IS epoch
+        `expected` — the guard every epoch-scoped decision runs first."""
+        if self.epoch != int(expected):
+            core_telemetry.incr("dist.membership.stale")
+            raise StaleMembershipError(
+                f"membership epoch {self.epoch} != required {expected}")
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "hosts": [h.to_dict() for h in self.hosts]}
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "MembershipView":
+        return cls(doc["epoch"],
+                   [HostInfo.from_dict(h) for h in doc["hosts"]])
+
+    def __repr__(self) -> str:
+        return (f"MembershipView(epoch={self.epoch}, "
+                f"hosts={self.host_ids}, devices={self.total_devices})")
+
+
+def _atomic_write_json(path: str, doc: dict) -> None:
+    # tmp + fsync + rename: a crash mid-write leaves the previous file,
+    # never a torn one (the G404-enforced durable-write idiom)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class MembershipStore:
+    """Coordinator-persisted membership + a file-based rendezvous and
+    heartbeat plane.
+
+    Layout under `root`::
+
+        hosts/<host_id>.json   registrations (rendezvous intake)
+        beats/<host_id>.json   monotone heartbeat sequence numbers
+        membership.json        the current MembershipView (atomic)
+
+    On a real pod the same API rides the jax coordination service's
+    key-value store; the file plane is the CPU-backend stand-in that
+    lets multi-process soaks run anywhere (tools/dist_soak.py).  Beat
+    *freshness* is judged by sequence advance observed through the
+    monitor's own injectable clock — never by comparing wall clocks
+    across processes."""
+
+    def __init__(self, root):
+        self.root = os.fspath(root)
+        self._hosts_dir = os.path.join(self.root, "hosts")
+        self._beats_dir = os.path.join(self.root, "beats")
+        os.makedirs(self._hosts_dir, exist_ok=True)
+        os.makedirs(self._beats_dir, exist_ok=True)
+        self._path = os.path.join(self.root, "membership.json")
+        self._lock = make_lock("parallel.dist.membership")
+        self._beat_seq: Dict[str, int] = {}  #: guarded-by self._lock
+
+    # ---- registration / view -------------------------------------------
+
+    def register(self, info: HostInfo) -> None:
+        _atomic_write_json(
+            os.path.join(self._hosts_dir, f"{info.host_id}.json"),
+            info.to_dict())
+
+    def registered(self) -> List[HostInfo]:
+        out = []
+        for fn in sorted(os.listdir(self._hosts_dir)):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self._hosts_dir, fn)) as f:
+                    out.append(HostInfo.from_dict(json.load(f)))
+            except (OSError, ValueError, KeyError):
+                continue  # torn mid-write: the next poll sees it whole
+        return out
+
+    def publish(self, view: MembershipView) -> MembershipView:
+        """Coordinator-only: persist `view`.  The epoch must strictly
+        advance past the stored one (stale publishes raise — a delayed
+        coordinator must not resurrect a dead host's devices)."""
+        current = self.load()
+        if current is not None and view.epoch <= current.epoch:
+            core_telemetry.incr("dist.membership.stale")
+            raise StaleMembershipError(
+                f"cannot publish epoch {view.epoch} over "
+                f"epoch {current.epoch}")
+        _atomic_write_json(self._path, view.to_dict())
+        core_telemetry.incr("dist.membership.update")
+        core_telemetry.gauge("dist.membership.epoch").set(view.epoch)
+        core_telemetry.gauge("dist.membership.hosts").set(len(view.hosts))
+        return view
+
+    def load(self) -> Optional[MembershipView]:
+        try:
+            with open(self._path) as f:
+                return MembershipView.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    # ---- rendezvous -----------------------------------------------------
+
+    def rendezvous(self, info: HostInfo, expected: int,
+                   coordinator: bool = False,
+                   timeout_s: float = 60.0,
+                   poll_s: float = 0.05,
+                   seed: int = 0) -> MembershipView:
+        """File-plane rendezvous: register, then either collect `expected`
+        registrations and publish epoch 1 (coordinator) or wait for the
+        published view (followers).  Registration attempts cross the
+        ``dist.rendezvous`` fault point with full-jitter retries under a
+        hard deadline, same contract as `initialize_distributed`."""
+        rng = random.Random(f"{seed}:{info.host_id}:dist.rendezvous")
+        deadline = monotonic() + float(timeout_s)
+        attempt = 0
+        while True:
+            core_telemetry.incr("dist.rendezvous.attempt")
+            try:
+                fault_point("dist.rendezvous")
+                self.register(info)
+                break
+            except (InjectedFault, OSError) as e:
+                if monotonic() >= deadline:
+                    core_telemetry.incr("dist.rendezvous.failed")
+                    raise RendezvousError(
+                        f"{info.host_id} could not register within "
+                        f"{timeout_s:.0f}s: {e!r}") from e
+                core_telemetry.incr("dist.rendezvous.retry")
+                sleep(min(rng.uniform(0.0, 0.05 * (2.0 ** attempt)),
+                          max(0.0, deadline - monotonic())))
+                attempt += 1
+        t0 = monotonic()
+        while monotonic() < deadline:
+            if coordinator:
+                roster = self.registered()
+                if len(roster) >= int(expected):
+                    view = self.load()
+                    if view is None:
+                        view = self.publish(MembershipView(1, roster))
+                    core_telemetry.histogram(
+                        "dist.rendezvous.latency").observe(
+                            monotonic() - t0)
+                    return view
+            else:
+                view = self.load()
+                if view is not None:
+                    core_telemetry.histogram(
+                        "dist.rendezvous.latency").observe(
+                            monotonic() - t0)
+                    return view
+            sleep(poll_s)
+        core_telemetry.incr("dist.rendezvous.failed")
+        raise RendezvousError(
+            f"{info.host_id} rendezvous timed out after {timeout_s:.0f}s "
+            f"({len(self.registered())}/{expected} hosts registered)")
+
+    # ---- heartbeats -----------------------------------------------------
+
+    def heartbeat(self, host_id: str) -> None:
+        """Bump this host's monotone beat sequence on the shared plane."""
+        with self._lock:
+            seq = self._beat_seq.get(host_id, 0) + 1
+            self._beat_seq[host_id] = seq
+        _atomic_write_json(
+            os.path.join(self._beats_dir, f"{host_id}.json"),
+            {"host_id": host_id, "seq": seq})
+
+    def read_beats(self) -> Dict[str, int]:
+        """host_id -> latest beat sequence (the HeartbeatMonitor `source`)."""
+        out: Dict[str, int] = {}
+        for fn in os.listdir(self._beats_dir):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self._beats_dir, fn)) as f:
+                    doc = json.load(f)
+                out[str(doc["host_id"])] = int(doc["seq"])
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Host-death detection: the heartbeat/lease monitor
+# ---------------------------------------------------------------------------
+
+class HeartbeatMonitor:
+    """Lease-based liveness: a host whose beats stop for longer than
+    `lease_s` is declared lost EXACTLY once (``dist.host.lost`` counter +
+    record + `on_lost` callback), so the loss is *detected* — not
+    discovered by a wedged allreduce.
+
+    Beats arrive either in-process (:meth:`beat`, crossing the
+    ``dist.heartbeat`` fault point — an injected fault models a dropped
+    heartbeat message, counted ``dist.heartbeat.missed``) or from a
+    shared plane via :meth:`ingest` (sequence-advance semantics: lease
+    age is measured on THIS monitor's injectable clock, never by
+    comparing wall clocks across hosts).  `start()` runs the poll loop
+    on a non-daemon ``dist-heartbeat-monitor`` thread (covered by the
+    conftest leak check); tests drive :meth:`check_now` directly under a
+    ``VirtualClock``."""
+
+    def __init__(self, hosts: Sequence[str],
+                 lease_s: float = 5.0,
+                 poll_s: float = 0.25,
+                 clock: Optional[Callable[[], float]] = None,
+                 on_lost: Optional[Callable[[str, dict], None]] = None,
+                 source: Optional[Callable[[], Dict[str, int]]] = None,
+                 self_id: Optional[str] = None):
+        self.lease_s = float(lease_s)
+        self.poll_s = float(poll_s)
+        self._clock = clock if clock is not None else monotonic
+        self.on_lost = on_lost
+        self._source = source
+        self.self_id = self_id
+        self._lock = make_lock("parallel.dist.heartbeat")
+        now = self._clock()
+        # every tracked host starts with a full lease at construction
+        self._last: Dict[str, float] = {str(h): now for h in hosts}  #: guarded-by self._lock
+        self._seqs: Dict[str, int] = {}  #: guarded-by self._lock
+        self.lost: Dict[str, dict] = {}  #: guarded-by self._lock
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- beats ----------------------------------------------------------
+
+    def beat(self, host_id: str) -> bool:
+        """Record one in-process heartbeat.  Returns False when the beat
+        was dropped (injected ``dist.heartbeat`` fault)."""
+        try:
+            fault_point("dist.heartbeat")
+        except InjectedFault:
+            core_telemetry.incr("dist.heartbeat.missed")
+            return False
+        with self._lock:
+            self._last[str(host_id)] = self._clock()
+        return True
+
+    def ingest(self, seqs: Dict[str, int]) -> None:
+        """Fold shared-plane beat sequences in: a host whose sequence
+        ADVANCED since the last ingest beat "now" on this clock."""
+        now = self._clock()
+        with self._lock:
+            for host, seq in seqs.items():
+                host = str(host)
+                if host not in self._last:
+                    continue  # not in the tracked roster
+                if self._seqs.get(host) != int(seq):
+                    self._seqs[host] = int(seq)
+                    self._last[host] = now
+
+    # ---- detection ------------------------------------------------------
+
+    def check_now(self) -> List[str]:
+        """Evaluate every lease; returns the hosts NEWLY declared lost
+        (each host fires at most once, ever — the `lost` latch)."""
+        now = self._clock()
+        newly: List[str] = []
+        with self._lock:
+            for host, last in self._last.items():
+                if host in self.lost or host == self.self_id:
+                    continue
+                age = now - last
+                if age > self.lease_s:
+                    self.lost[host] = {
+                        "host_id": host, "kind": "lease_expired",
+                        "last_beat_age_s": round(age, 3),
+                        "lease_s": self.lease_s,
+                    }
+                    newly.append(host)
+        for host in newly:
+            # outside the lock: counters/callback must not serialize beats
+            self._announce(host)
+        return newly
+
+    def declare_lost(self, host_id: str,
+                     record: Optional[dict] = None) -> bool:
+        """Declare `host_id` lost out-of-band (an injected
+        ``training.host_lost`` fault, an operator decision).  Same
+        exactly-once latch and announcement as a lease expiry."""
+        host = str(host_id)
+        with self._lock:
+            if host in self.lost:
+                return False
+            rec = {"host_id": host, "kind": "declared"}
+            rec.update(record or {})
+            self.lost[host] = rec
+        self._announce(host)
+        return True
+
+    def _announce(self, host: str) -> None:
+        core_telemetry.incr("dist.host.lost")
+        core_telemetry.incr(f"dist.host.lost.{host}")
+        with self._lock:
+            rec = dict(self.lost[host])
+        with core_telemetry.span("dist.host.lost") as sp:
+            sp.attrs.update(rec)
+        if self.on_lost is not None:
+            self.on_lost(host, rec)
+
+    def alive(self) -> List[str]:
+        with self._lock:
+            return [h for h in self._last if h not in self.lost]
+
+    # ---- lifecycle ------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "HeartbeatMonitor":
+        if not self.running:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="dist-heartbeat-monitor",
+                daemon=False)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(timeout=self.poll_s):
+            if self._source is not None:
+                try:
+                    self.ingest(self._source())
+                except Exception:  # noqa: BLE001 — a torn read is a missed poll
+                    pass
+            self.check_now()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def __enter__(self) -> "HeartbeatMonitor":
+        return self.start()
+
+    def __exit__(self, *_exc) -> None:
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# Elasticity: the per-step harness the training loop polls
+# ---------------------------------------------------------------------------
+
+class ElasticContext:
+    """Glue between the membership plane and `fit_epochs_resumable`'s
+    elastic mode.  Once per step the loop calls :meth:`poll`:
+
+    1. beat this host's lease (store and/or in-process monitor);
+    2. cross the ``training.host_lost`` fault point — an injected fault
+       simulates the death of the next live peer, driving the exact
+       same downstream ladder as a real lease expiry;
+    3. coordinator: ingest shared-plane beats + evaluate leases;
+       follower: adopt a newer epoch the coordinator published.
+
+    A non-empty return is the list of peers lost since the last poll;
+    the loop then runs the quarantine → checkpoint-floor rollback →
+    :meth:`commit_loss` (epoch advance) → :meth:`rebuild` (mesh over the
+    survivors) ladder (docs/robustness.md "Elastic multi-host")."""
+
+    def __init__(self, host: HostInfo, view: MembershipView,
+                 store: Optional[MembershipStore] = None,
+                 monitor: Optional[HeartbeatMonitor] = None,
+                 coordinator: Optional[bool] = None,
+                 rebuild: Optional[Callable[[MembershipView],
+                                            Optional[tuple]]] = None,
+                 hang_budget_s: Optional[float] = None):
+        self.host = host
+        self.view = view
+        self.store = store
+        self.monitor = monitor
+        self.coordinator = (coordinator if coordinator is not None
+                            else host.process_index == 0)
+        self._rebuild = rebuild
+        self.hang_budget_s = hang_budget_s
+        self._lock = make_lock("parallel.dist.elastic")
+        self._pending: List[str] = []  #: guarded-by self._lock
+        if monitor is not None and monitor.on_lost is None:
+            monitor.on_lost = self._notice
+        core_telemetry.gauge("dist.membership.epoch").set(view.epoch)
+        core_telemetry.gauge("dist.membership.hosts").set(len(view.hosts))
+
+    def _notice(self, host_id: str, record: dict) -> None:
+        with self._lock:
+            self._pending.append(str(host_id))
+
+    def _next_live_peer(self) -> Optional[str]:
+        lost = set(self.monitor.lost) if self.monitor is not None else set()
+        with self._lock:
+            lost |= set(self._pending)
+        for h in self.view.host_ids:
+            if h != self.host.host_id and h not in lost:
+                return h
+        return None
+
+    def poll(self) -> Optional[List[str]]:
+        """One elastic tick; returns newly lost peers (None when quiet)."""
+        roster = set(self.view.host_ids)  # pre-adoption: the epoch we ran
+        if self.store is not None:
+            self.store.heartbeat(self.host.host_id)
+        if self.monitor is not None:
+            self.monitor.beat(self.host.host_id)
+        try:
+            fault_point("training.host_lost")
+        except InjectedFault:
+            victim = self._next_live_peer()
+            if victim is not None:
+                if self.monitor is not None:
+                    self.monitor.declare_lost(
+                        victim, {"kind": "injected"})
+                else:
+                    self._notice(victim, {"kind": "injected"})
+        if self.monitor is not None and self.coordinator:
+            if self.store is not None:
+                self.monitor.ingest(self.store.read_beats())
+            self.monitor.check_now()
+        elif self.store is not None and not self.coordinator:
+            latest = self.store.load()
+            if latest is not None and latest.epoch > self.view.epoch:
+                gone = set(self.view.host_ids) - set(latest.host_ids)
+                with self._lock:
+                    self._pending.extend(sorted(gone))
+                self.view = latest
+                core_telemetry.gauge("dist.membership.epoch").set(
+                    latest.epoch)
+                core_telemetry.gauge("dist.membership.hosts").set(
+                    len(latest.hosts))
+        with self._lock:
+            pending, self._pending = self._pending, []
+        # de-dup while keeping order; drop hosts that were already gone
+        # from the roster BEFORE this poll (a repeated announcement)
+        seen: List[str] = []
+        for h in pending:
+            if h not in seen and h in roster:
+                seen.append(h)
+        return seen or None
+
+    def commit_loss(self, lost: Sequence[str]) -> MembershipView:
+        """Advance the membership epoch past `lost`.  The coordinator
+        publishes the shrunken view (stale publishes raise); followers
+        that already adopted the published epoch keep it."""
+        gone = [h for h in lost if h in self.view.host_ids]
+        if not gone:
+            return self.view
+        new_view = self.view.without(*gone)
+        if self.store is not None and self.coordinator:
+            self.store.publish(new_view)
+        else:
+            core_telemetry.gauge("dist.membership.epoch").set(
+                new_view.epoch)
+            core_telemetry.gauge("dist.membership.hosts").set(
+                len(new_view.hosts))
+        self.view = new_view
+        return new_view
+
+    def rebuild(self, view: MembershipView) -> Optional[tuple]:
+        """The survivor-mesh hook: `(mesh, step_fn)` from the caller's
+        rebuild callback (re-running `MeshPlan` with the shrunken data
+        axis), or None when the local layout is unchanged."""
+        if self._rebuild is None:
+            return None
+        return self._rebuild(view)
+
+
+# ---------------------------------------------------------------------------
+# Per-host telemetry endpoint (the federation wire format)
+# ---------------------------------------------------------------------------
+
+class HostTelemetryServer:
+    """Minimal per-host observability endpoint: ``/metrics.json`` serves
+    this process's ``export_snapshot`` — byte-compatible with what
+    `serving.fleet.FleetTelemetry.pull_once` scrapes from replicas — and
+    ``/health`` serves liveness, so a pod's hosts federate into one
+    ``/fleet/metrics`` view through the PR 15 ``merge_snapshots`` plane
+    without running a full WorkerServer."""
+
+    def __init__(self, host_id: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.host_id = str(host_id)
+        self.host = host
+        self.port = int(port)
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> Tuple[str, int]:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        host_id = self.host_id
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/metrics.json":
+                    payload = json.dumps(
+                        core_telemetry.export_snapshot(
+                            include_spans=False),
+                        default=repr).encode("utf-8")
+                elif path == "/health":
+                    payload = json.dumps(
+                        {"status": "ok",
+                         "host_id": host_id}).encode("utf-8")
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=False,
+            name=f"dist-host-telemetry-{self.host_id}")
+        self._thread.start()
+        return self.host, self.port
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def __enter__(self) -> "HostTelemetryServer":
+        self.start()
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.stop()

@@ -50,6 +50,7 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "addressable_shard_layout",
+    "host_device_groups",
     "shard_batch",
     "pad_to_multiple",
 ]
@@ -218,6 +219,22 @@ def addressable_shard_layout(sharding, shape):
     if not imap or any(idx is None for idx in imap.values()):
         return None
     return sorted(imap.items(), key=lambda di: di[0].id)
+
+
+def host_device_groups(devices, num_hosts: int):
+    """Partition `devices` into `num_hosts` equal contiguous groups — the
+    simulated-host layout elastic tests and soaks use on the forced
+    virtual CPU mesh (tools/dist_soak.py leg A treats 8 devices as
+    4 hosts x 2 chips).  A real pod never calls this: per-process
+    addressability already partitions the device set, and
+    `addressable_shard_layout` above is per-host by construction."""
+    devices = list(devices)
+    n = len(devices)
+    if num_hosts < 1 or n % num_hosts != 0:
+        raise ValueError(
+            f"{n} devices do not split into {num_hosts} equal hosts")
+    per = n // num_hosts
+    return [devices[i * per:(i + 1) * per] for i in range(num_hosts)]
 
 
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0) -> Tuple[np.ndarray, int]:

@@ -91,7 +91,7 @@ def pytest_unconfigure(config):
 # threads fail the test outright: daemon pool threads
 # (ThreadPoolExecutor) park harmlessly.
 _INFRA_PREFIXES = ("serve-", "serving-", "continuous-batcher", "stream-",
-                   "train-guard", "flow-")
+                   "train-guard", "flow-", "dist-")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,406 @@
+"""Elastic multi-host runtime: rendezvous hardening, membership epochs,
+heartbeat-lease host-death detection, hang-budget collectives, and the
+shrink-and-resume training ladder (docs/robustness.md "Elastic
+multi-host").  Everything here runs single-process on the 8-device
+virtual CPU mesh; the real multi-process pod is soaked by
+tools/dist_soak.py."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import telemetry as core_telemetry
+from mmlspark_tpu.parallel import distributed as dist
+from mmlspark_tpu.utils.faults import (FAULTS, FaultPlan, VirtualClock,
+                                       use_clock)
+
+
+def _dist_counters():
+    return core_telemetry.counters("dist.")
+
+
+def _delta(before, after, name):
+    return after.get(name, 0) - before.get(name, 0)
+
+
+# ---------------------------------------------------------------- rendezvous
+
+
+def test_single_process_fallback(monkeypatch):
+    """No coordinator address → local mesh, no runtime calls, this
+    process IS the coordinator."""
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    dist.reset_distributed_state()
+    calls = []
+    dist.initialize_distributed(_initialize=lambda **kw: calls.append(kw))
+    assert calls == []
+    assert dist.is_coordinator()
+    # idempotent: the latch short-circuits later calls
+    dist.initialize_distributed(_initialize=lambda **kw: calls.append(kw))
+    assert calls == []
+
+
+def test_rendezvous_retries_with_backoff_then_succeeds():
+    dist.reset_distributed_state()
+    before = _dist_counters()
+    attempts = []
+
+    def flaky(**kw):
+        attempts.append(kw)
+        if len(attempts) < 3:
+            raise RuntimeError("connection refused")
+
+    clock = VirtualClock()
+    with use_clock(clock):
+        dist.initialize_distributed(
+            coordinator_address="10.0.0.1:1234", num_processes=2,
+            process_id=1, max_attempts=3, backoff_s=0.5, timeout_s=60.0,
+            _initialize=flaky)
+    after = _dist_counters()
+    assert len(attempts) == 3
+    assert attempts[0]["num_processes"] == 2
+    assert attempts[0]["process_id"] == 1
+    assert _delta(before, after, "dist.rendezvous.attempt") == 3
+    assert _delta(before, after, "dist.rendezvous.retry") == 2
+    assert _delta(before, after, "dist.rendezvous.failed") == 0
+    dist.reset_distributed_state()
+
+
+def test_rendezvous_exhaustion_raises():
+    dist.reset_distributed_state()
+    before = _dist_counters()
+
+    def dead(**kw):
+        raise RuntimeError("connection refused")
+
+    with use_clock(VirtualClock()):
+        with pytest.raises(dist.RendezvousError, match="refused"):
+            dist.initialize_distributed(
+                coordinator_address="10.0.0.1:1234", num_processes=2,
+                process_id=0, max_attempts=3, timeout_s=60.0,
+                _initialize=dead)
+    after = _dist_counters()
+    assert _delta(before, after, "dist.rendezvous.failed") == 1
+    dist.reset_distributed_state()
+
+
+def test_already_initialized_detected_precisely():
+    """'Distributed system is already initialized' is a success; an
+    arbitrary message that merely CONTAINS 'already' (the old substring
+    bug) is a real failure and must retry/raise."""
+    dist.reset_distributed_state()
+
+    def auto(**kw):
+        raise RuntimeError("Distributed system is already initialized")
+
+    dist.initialize_distributed(
+        coordinator_address="10.0.0.1:1234", num_processes=2,
+        process_id=0, _initialize=auto)
+
+    dist.reset_distributed_state()
+    n = {"calls": 0}
+
+    def other(**kw):
+        n["calls"] += 1
+        raise RuntimeError("stream already closed by peer")
+
+    with use_clock(VirtualClock()):
+        with pytest.raises(dist.RendezvousError, match="already closed"):
+            dist.initialize_distributed(
+                coordinator_address="10.0.0.1:1234", num_processes=2,
+                process_id=0, max_attempts=2, timeout_s=60.0,
+                _initialize=other)
+    assert n["calls"] == 2  # retried: NOT swallowed as already-initialized
+    dist.reset_distributed_state()
+
+
+def test_rendezvous_fault_point_armed():
+    dist.reset_distributed_state()
+    ok = {"n": 0}
+    plan = FaultPlan(seed=3).on("dist.rendezvous", nth=[0])
+    with use_clock(VirtualClock()):
+        with FAULTS.arm(plan):
+            dist.initialize_distributed(
+                coordinator_address="10.0.0.1:1234", num_processes=2,
+                process_id=0, max_attempts=3, timeout_s=60.0,
+                _initialize=lambda **kw: ok.__setitem__("n", ok["n"] + 1))
+    assert FAULTS.fires.get("dist.rendezvous") == 1
+    assert ok["n"] == 1  # first crossing injected, retry succeeded
+    dist.reset_distributed_state()
+
+
+# ---------------------------------------------------------------- membership
+
+
+def test_membership_epochs_advance_and_reject_stale(tmp_path):
+    store = dist.MembershipStore(tmp_path)
+    h0 = dist.HostInfo("h0", 0, 2)
+    h1 = dist.HostInfo("h1", 1, 2)
+    view = store.publish(dist.MembershipView(1, [h0, h1]))
+    assert view.total_devices == 4
+    assert store.load().host_ids == ["h0", "h1"]
+
+    shrunk = view.without("h1")
+    assert shrunk.epoch == 2 and shrunk.host_ids == ["h0"]
+    store.publish(shrunk)
+    before = _dist_counters()
+    with pytest.raises(dist.StaleMembershipError):
+        store.publish(view.without("h0"))  # epoch 2 again: stale
+    with pytest.raises(dist.StaleMembershipError):
+        shrunk.require_epoch(1)
+    after = _dist_counters()
+    assert _delta(before, after, "dist.membership.stale") == 2
+    with pytest.raises(KeyError):
+        shrunk.without("h1")  # already gone
+    with pytest.raises(ValueError):
+        shrunk.without("h0")  # cannot shrink to empty
+
+
+def test_file_plane_rendezvous(tmp_path):
+    """Coordinator + follower converge on one epoch-1 view through the
+    file plane (the multi-process soak joins exactly this way)."""
+    store = dist.MembershipStore(tmp_path)
+    h0 = dist.HostInfo("h0", 0, 2)
+    h1 = dist.HostInfo("h1", 1, 2)
+    store.register(h1)  # the "other process" registered already
+    view = store.rendezvous(h0, expected=2, coordinator=True,
+                            timeout_s=5.0)
+    assert view.epoch == 1 and view.host_ids == ["h0", "h1"]
+    # follower path: the published view is adopted as-is
+    assert store.rendezvous(h1, expected=2, timeout_s=5.0).epoch == 1
+
+
+def test_file_plane_rendezvous_timeout(tmp_path):
+    store = dist.MembershipStore(tmp_path)
+    with use_clock(VirtualClock()):
+        with pytest.raises(dist.RendezvousError, match="1/3"):
+            store.rendezvous(dist.HostInfo("h0", 0, 2), expected=3,
+                             coordinator=True, timeout_s=2.0)
+
+
+# ----------------------------------------------------------- host detection
+
+
+def test_lease_expiry_fires_host_lost_exactly_once():
+    clock = VirtualClock()
+    losses = []
+    mon = dist.HeartbeatMonitor(
+        ["h0", "h1", "h2"], lease_s=2.0, clock=clock.monotonic,
+        on_lost=lambda h, rec: losses.append((h, rec)), self_id="h0")
+    before = _dist_counters()
+    clock.advance(1.5)
+    mon.beat("h1")
+    mon.beat("h2")
+    assert mon.check_now() == []
+    clock.advance(1.5)
+    mon.beat("h1")  # h2 goes silent
+    assert mon.check_now() == []  # h2's lease not lapsed yet (age 1.5)
+    clock.advance(1.0)
+    assert mon.check_now() == ["h2"]  # age 2.5 > lease
+    # exactly once: further checks never re-fire, however stale h2 gets
+    clock.advance(100.0)
+    mon.beat("h1")
+    assert mon.check_now() == []
+    after = _dist_counters()
+    assert _delta(before, after, "dist.host.lost") == 1
+    assert _delta(before, after, "dist.host.lost.h2") == 1
+    assert [h for h, _ in losses] == ["h2"]
+    assert losses[0][1]["kind"] == "lease_expired"
+    assert losses[0][1]["lease_s"] == 2.0
+    assert mon.alive() == ["h0", "h1"]
+    # self is never declared lost, no matter how stale
+    assert "h0" not in mon.lost
+
+
+def test_heartbeat_fault_drops_beat():
+    mon = dist.HeartbeatMonitor(["h0"], lease_s=5.0)
+    before = _dist_counters()
+    with FAULTS.arm(FaultPlan(seed=5).on("dist.heartbeat", nth=[0])):
+        assert mon.beat("h0") is False
+        assert mon.beat("h0") is True
+    after = _dist_counters()
+    assert _delta(before, after, "dist.heartbeat.missed") == 1
+    assert FAULTS.fires.get("dist.heartbeat") == 1
+
+
+def test_ingest_uses_sequence_advance_not_wall_clocks():
+    """A repeated (stale) sequence number is NOT a fresh beat; only an
+    advance refreshes the lease — freshness never compares wall clocks
+    across hosts."""
+    clock = VirtualClock()
+    mon = dist.HeartbeatMonitor(["h1"], lease_s=2.0,
+                                clock=clock.monotonic)
+    mon.ingest({"h1": 7})
+    clock.advance(1.5)
+    mon.ingest({"h1": 7})  # same seq: stale, lease keeps aging
+    clock.advance(1.0)
+    assert mon.check_now() == ["h1"]
+
+
+def test_monitor_thread_lifecycle(tmp_path):
+    store = dist.MembershipStore(tmp_path)
+    store.heartbeat("h1")
+    mon = dist.HeartbeatMonitor(["h1"], lease_s=30.0, poll_s=0.01,
+                                source=store.read_beats)
+    with mon:
+        assert mon.running
+    assert not mon.running
+    assert mon.alive() == ["h1"]
+
+
+# -------------------------------------------------------- deadline guard
+
+
+def test_run_with_deadline_result_error_and_timeout():
+    import time
+
+    assert dist.run_with_deadline(lambda: 42, 5.0, name="x") == 42
+    assert dist.run_with_deadline(lambda: 42, None, name="x") == 42
+    with pytest.raises(KeyError):
+        dist.run_with_deadline(lambda: {}["missing"], 5.0, name="x")
+    before = _dist_counters()
+    with pytest.raises(dist.CollectiveTimeout, match="hang budget"):
+        dist.run_with_deadline(lambda: time.sleep(0.4), 0.05, name="x")
+    after = _dist_counters()
+    assert _delta(before, after, "dist.collective.overrun") == 1
+
+
+# ------------------------------------------------------- elastic training
+
+
+@pytest.fixture()
+def tiny_train():
+    import flax.linen as nn
+    import optax
+
+    from mmlspark_tpu.models.training import (init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x), {}
+
+    model, opt = M(), optax.sgd(0.1)
+    mesh = default_mesh()
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(96, 4, 4, 1)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=96)
+    step = make_train_step(model, opt, 4, mesh=mesh, donate=False)
+
+    def fresh():
+        return init_train_state(model, opt, (4, 4, 1), seed=0)
+
+    return dict(model=model, opt=opt, mesh=mesh, imgs=imgs, lbls=lbls,
+                step=step, fresh=fresh)
+
+
+@pytest.mark.chaos
+def test_elastic_shrink_and_resume(tmp_path, tiny_train):
+    """Injected peer death mid-run drives the whole ladder: guard ledger
+    + quarantine.json, checkpoint-floor rollback, epoch advance, mesh
+    rebuilt over the survivors (data 8 → 6), schedule completed with a
+    finite loss on the shrunken mesh."""
+    import json
+
+    import jax
+    import optax
+
+    from mmlspark_tpu.models.guard import TrainingGuard
+    from mmlspark_tpu.models.training import (fit_epochs_resumable,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import host_device_groups, make_mesh
+
+    host_ids = ["h0", "h1", "h2", "h3"]
+    groups = host_device_groups(jax.devices(), 4)
+    hosts = [dist.HostInfo(h, i, len(groups[i]))
+             for i, h in enumerate(host_ids)]
+    view = dist.MembershipView(1, hosts)
+    mon = dist.HeartbeatMonitor(host_ids, lease_s=1e9, self_id="h0")
+    rebuilds = []
+
+    def rebuild(v):
+        devs = [d for i, h in enumerate(host_ids)
+                if h in v.host_ids for d in groups[i]]
+        mesh = make_mesh(devices=devs)
+        rebuilds.append(mesh.shape["data"])
+        step = make_train_step(tiny_train["model"], optax.sgd(0.1), 4,
+                               mesh=mesh, donate=False)
+        return mesh, step
+
+    ctx = dist.ElasticContext(hosts[0], view, monitor=mon,
+                              coordinator=True, rebuild=rebuild)
+    guard = TrainingGuard(watchdog=False, hang_timeout_s=120.0)
+    plan = FaultPlan(seed=11).on("training.host_lost", nth=[2])
+    with FAULTS.arm(plan):
+        state, metrics = fit_epochs_resumable(
+            tiny_train["step"], tiny_train["fresh"](),
+            tiny_train["imgs"], tiny_train["lbls"], batch_size=24,
+            checkpoint_dir=str(tmp_path), epochs=1, checkpoint_every=2,
+            mesh=tiny_train["mesh"], seed=0, guard=guard, elastic=ctx)
+
+    assert FAULTS.fires.get("training.host_lost") == 1
+    # the injected victim is the first live peer of h0
+    assert [r["host_id"] for r in guard.lost_hosts] == ["h1"]
+    assert ctx.view.epoch == 2
+    assert ctx.view.host_ids == ["h0", "h2", "h3"]
+    assert rebuilds == [6]  # data axis shrank 8 -> 6
+    assert int(state.step) == 4  # full schedule completed, no dup steps
+    assert np.isfinite(metrics["loss"])
+    # the loss is ledgered durably next to the checkpoints
+    qdoc = json.loads((tmp_path / "quarantine.json").read_text())
+    assert qdoc["lost_hosts"] and qdoc["lost_hosts"][0]["host_id"] == "h1"
+    assert qdoc["lost_hosts"][0]["epoch"] == 2
+    # host loss consumes NO rollback budget and backs off NO lr
+    assert guard.rollbacks == 0 and guard.lr_scale == 1.0
+
+
+def test_elastic_follower_adopts_published_epoch(tmp_path):
+    """Coordinator detects + publishes; a follower polling the store
+    adopts the shrunken epoch and reports the same losses."""
+    store = dist.MembershipStore(tmp_path)
+    hosts = [dist.HostInfo(f"h{i}", i, 2) for i in range(3)]
+    view = store.publish(dist.MembershipView(1, hosts))
+    mon = dist.HeartbeatMonitor([h.host_id for h in hosts],
+                                lease_s=1e9, self_id="h0")
+    coord = dist.ElasticContext(hosts[0], view, store=store, monitor=mon,
+                                coordinator=True)
+    follower = dist.ElasticContext(hosts[1], view, store=store,
+                                   coordinator=False)
+    assert coord.poll() is None and follower.poll() is None
+
+    mon.declare_lost("h2", {"kind": "injected"})
+    lost = coord.poll()
+    assert lost == ["h2"]
+    assert coord.commit_loss(lost).epoch == 2
+    assert store.load().epoch == 2  # coordinator published
+
+    assert follower.poll() == ["h2"]  # adopted from the store
+    assert follower.view.epoch == 2
+    assert follower.commit_loss(["h2"]).epoch == 2  # already adopted: no-op
+
+
+def test_host_telemetry_server_serves_snapshot_wire_format():
+    import json
+    import urllib.request
+
+    core_telemetry.incr("dist.rendezvous.attempt")
+    srv = dist.HostTelemetryServer("h0")
+    try:
+        host, port = srv.start()
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics.json", timeout=10) as r:
+            snap = json.load(r)
+        assert snap["counters"]["dist.rendezvous.attempt"] >= 1
+        assert "gauges" in snap and "histograms" in snap
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/health", timeout=10) as r:
+            assert json.load(r)["host_id"] == "h0"
+    finally:
+        srv.stop()
+
+    from mmlspark_tpu.core.telemetry.fleet import merge_snapshots
+    merged = merge_snapshots({"h0": snap, "h1": snap})
+    assert (merged["counters"]["dist.rendezvous.attempt"]
+            == 2 * snap["counters"]["dist.rendezvous.attempt"])

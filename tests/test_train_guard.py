@@ -201,7 +201,7 @@ def test_save_writes_manifest_and_restore_verifies(tmp_path, tiny_train):
         manifest = tmp_path / "5" / MANIFEST_NAME
         assert manifest.exists()
         doc = json.loads(manifest.read_text())
-        assert doc["format"] == 1 and doc["leaves"]
+        assert doc["format"] == 2 and doc["leaves"]
         before = telemetry.histogram(
             "checkpoint.verify.latency").snapshot()["count"]
         out = mgr.restore(step=5, template=state)

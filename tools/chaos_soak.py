@@ -541,6 +541,162 @@ def run_flow_soak(seed: int = 7, n_items: int = 48, max_pending: int = 24,
     }
 
 
+def run_dist_soak(seed: int = 7) -> dict:
+    """Soak the elastic multi-host runtime (parallel/distributed.py)
+    with seeded faults armed at EVERY registered `dist.*` point
+    (`DIST_FAULT_POINTS` — the programmatic registry, so a point added
+    there is covered automatically and the stale-config check below
+    fails if a scripted point vanishes).  Three scripted scenes:
+
+      * **rendezvous** — the first file-plane registration attempt takes
+        an `InjectedFault`; the full-jitter retry ladder must absorb it
+        and still converge on the epoch-1 view (``dist.rendezvous.retry``
+        reconciles with the injector's fires);
+      * **heartbeats** — two scripted beat drops (`dist.heartbeat`)
+        are *lost messages*, not deaths: counted
+        ``dist.heartbeat.missed``, never declared lost;
+      * **host loss** — an injected ``training.host_lost`` fault inside
+        a real `fit_epochs_resumable` run drives the whole quarantine →
+        checkpoint rollback → epoch advance → mesh shrink (8→6 devices)
+        → resume ladder to a finite completion.
+
+    Runs under a `VirtualClock` (backoffs advance virtual time only)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.models.guard import TrainingGuard
+    from mmlspark_tpu.models.training import (fit_epochs_resumable,
+                                              init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel import distributed as dist
+    from mmlspark_tpu.parallel.mesh import host_device_groups, make_mesh
+    from mmlspark_tpu.utils.faults import (FAULTS, FaultPlan, VirtualClock,
+                                           use_clock)
+
+    telemetry.reset_counters()
+    config = {
+        "dist.rendezvous": dict(nth=[0]),
+        "dist.heartbeat": dict(nth=[1, 3]),
+        "training.host_lost": dict(nth=[2]),
+    }
+    armable = tuple(dist.DIST_FAULT_POINTS)
+    plan = FaultPlan(seed=seed)
+    for p in armable:
+        plan.on(p, **config.get(p, dict(nth=[0], latency_s=0.0,
+                                        error=None)))
+    missing = [p for p in config if p not in armable]
+    assert not missing, f"expected fault points unregistered: {missing}"
+
+    clock = VirtualClock()
+    host_ids = ["h0", "h1", "h2", "h3"]
+    groups = host_device_groups(jax.devices(), len(host_ids))
+    hosts = [dist.HostInfo(h, i, len(groups[i]))
+             for i, h in enumerate(host_ids)]
+
+    import flax.linen as nn
+    import optax
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x), {}
+
+    model = M()
+    # batch 24 divides both the full data axis (8) and the shrunken (6)
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(48, 4, 4, 1)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=48)
+
+    def make_step(m):
+        return make_train_step(model, optax.sgd(0.1), 4, mesh=m,
+                               donate=False)
+
+    with tempfile.TemporaryDirectory() as tmp, use_clock(clock), \
+            FAULTS.arm(plan):
+        # scene 1: rendezvous through the injected registration fault
+        store = dist.MembershipStore(Path(tmp) / "plane")
+        view1 = store.rendezvous(hosts[0], expected=1, coordinator=True,
+                                 timeout_s=30.0, seed=seed)
+        assert view1.epoch == 1 and view1.host_ids == ["h0"]
+        assert FAULTS.fires.get("dist.rendezvous", 0) == 1, \
+            "the scripted rendezvous fault never fired"
+
+        # scene 2: dropped heartbeats are missed messages, not deaths
+        mon2 = dist.HeartbeatMonitor(["h1"], lease_s=1e9,
+                                     clock=clock.monotonic)
+        beats = [mon2.beat("h1") for _ in range(4)]
+        assert beats == [True, False, True, False], \
+            f"beat drop schedule off: {beats}"
+        assert mon2.check_now() == [] and not mon2.lost, \
+            "a dropped heartbeat message was declared a death"
+
+        # scene 3: injected host loss inside a real training run
+        mon = dist.HeartbeatMonitor(host_ids, lease_s=1e9,
+                                    clock=clock.monotonic, self_id="h0")
+        rebuilds = []
+
+        def rebuild(v):
+            devs = [d for i, h in enumerate(host_ids)
+                    if h in v.host_ids for d in groups[i]]
+            mesh = make_mesh(devices=devs)
+            rebuilds.append(mesh.shape["data"])
+            return mesh, make_step(mesh)
+
+        ctx = dist.ElasticContext(
+            hosts[0], dist.MembershipView(1, hosts), monitor=mon,
+            coordinator=True, rebuild=rebuild, hang_budget_s=120.0)
+        guard = TrainingGuard(watchdog=False)
+        full_mesh = make_mesh(devices=jax.devices())
+        state, metrics = fit_epochs_resumable(
+            make_step(full_mesh),
+            init_train_state(model, optax.sgd(0.1), (4, 4, 1), seed=0),
+            imgs, lbls, batch_size=24, checkpoint_dir=tmp, epochs=2,
+            checkpoint_every=2, mesh=full_mesh, seed=seed, guard=guard,
+            elastic=ctx)
+        fires = dict(FAULTS.fires)
+
+    total = 2 * (48 // 24)
+    assert fires.get("training.host_lost", 0) == 1
+    assert fires.get("dist.heartbeat", 0) == 2
+    assert [r["host_id"] for r in guard.lost_hosts] == ["h1"], \
+        f"ladder ledgered {guard.lost_hosts}, want the first live peer"
+    assert ctx.view.epoch == 2 and rebuilds == [6]
+    assert int(state.step) == total and np.isfinite(metrics["loss"])
+
+    # registry reconciliation: the injector's fires and the declared
+    # dist.* counters tell the same story through the snapshot
+    snapshot = telemetry.export_snapshot()
+    c = snapshot["counters"]
+    assert c.get("faults.injected", 0) == sum(fires.values()), \
+        (f"registry faults.injected {c.get('faults.injected')} != "
+         f"fault-injector fires {sum(fires.values())}")
+    assert c.get("dist.rendezvous.retry", 0) >= 1, \
+        "the injected rendezvous fault never drove a retry"
+    assert c.get("dist.heartbeat.missed", 0) == 2
+    assert c.get("dist.host.lost", 0) == 1
+    assert c.get("dist.membership.update", 0) >= 1
+    return {
+        "seed": seed,
+        "mode": "dist",
+        "armed_points": list(armable),
+        "faults_fired": fires,
+        "rendezvous_epoch": view1.epoch,
+        "heartbeats_missed": c.get("dist.heartbeat.missed", 0),
+        "lost": [r["host_id"] for r in guard.lost_hosts],
+        "epoch_after_loss": ctx.view.epoch,
+        "data_axis_after": rebuilds[0],
+        "steps": int(state.step),
+        "final_loss": float(metrics["loss"]),
+        "counters": {k: v for k, v in c.items()
+                     if k.startswith(("dist.", "training.", "faults."))},
+    }
+
+
 def write_obs_snapshot(path) -> str:
     """Dump the full observability snapshot (counters, gauges, histogram
     buckets, AND the recent-span ring) to `path` — the input format
@@ -580,6 +736,11 @@ def main(argv=None):
                     help="soak the graftflow runtime (core/flow.py) with "
                          "faults at every registered flow.* point instead "
                          "of the HTTP stack")
+    ap.add_argument("--dist", action="store_true",
+                    help="soak the elastic multi-host runtime "
+                         "(parallel/distributed.py) with faults at every "
+                         "registered dist.* point instead of the HTTP "
+                         "stack")
     ap.add_argument("--max-pending", type=int, default=24,
                     help="--flow: AdmissionStage intake bound")
     ap.add_argument("--json", action="store_true",
@@ -588,7 +749,8 @@ def main(argv=None):
                     help="write the full observability snapshot (spans "
                          "included) to PATH for tools/obs_report.py")
     args = ap.parse_args(argv)
-    if args.flow and "xla_force_host_platform_device_count" not in \
+    if (args.flow or args.dist) and \
+            "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
         # the h2d leg's shard ladder needs a multi-device mesh; on a
         # bare CPU host force the 8-device virtual platform before jax
@@ -601,7 +763,9 @@ def main(argv=None):
     # sanitized by default: the soak is exactly the concurrency load the
     # lockset/credit audits exist for (GRAFTSAN=0 opts out)
     sanitizing = graftsan.soak_install()
-    if args.flow:
+    if args.dist:
+        summary = run_dist_soak(seed=args.seed)
+    elif args.flow:
         summary = run_flow_soak(seed=args.seed, n_items=args.requests,
                                 max_pending=args.max_pending)
     else:
@@ -619,6 +783,16 @@ def main(argv=None):
             rc = 1
     if args.json:
         print(json.dumps(summary, indent=2, sort_keys=True))
+    elif args.dist:
+        print(f"dist soak OK: rendezvous absorbed an injected fault "
+              f"(epoch {summary['rendezvous_epoch']}), "
+              f"{summary['heartbeats_missed']} heartbeats dropped "
+              f"without a false death, injected loss of "
+              f"{summary['lost']} -> epoch "
+              f"{summary['epoch_after_loss']}, data axis "
+              f"{summary['data_axis_after']}, {summary['steps']} steps, "
+              f"final loss {summary['final_loss']:.4f}; faults fired: "
+              f"{summary['faults_fired']}")
     elif args.flow:
         print(f"flow soak OK: {summary['delivered']} delivered, "
               f"{summary['shed']} shed at admission, "

@@ -18,6 +18,9 @@ anywhere:
                                             # alert -> autoscale ->
                                             # incident -> resolve
     python tools/ci.py flow-soak            # graftflow runtime chaos soak
+    python tools/ci.py dist-soak            # elastic multi-host: kill a
+                                            # pod host mid-epoch, shrink,
+                                            # resume on survivors
     python tools/ci.py feed-bench           # 3-path h2d transfer smoke
     python tools/ci.py parity-3d            # 3D-mesh trainer == single-
                                             # device losses (8-dev mesh)
@@ -410,10 +413,32 @@ def flow_soak(timeout_s: int = 300) -> int:
     return rc
 
 
+def dist_soak(timeout_s: int = 420) -> int:
+    """Run the elastic multi-host soak (tools/dist_soak.py): the
+    in-process lease-expiry shrink (8→6 device mesh, exactly-once
+    ledger, parity with an uninterrupted reference) plus a real
+    3-process pod with one host SIGKILLed mid-epoch — survivors
+    quarantine, adopt the shrunken membership epoch, resume from the
+    last verified checkpoint, and their per-host telemetry endpoints
+    federate into one fleet view.  CPU backend so the job runs on any
+    CI machine."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join("tools", "dist_soak.py"),
+           "--json"]
+    try:
+        rc = subprocess.call(cmd, cwd=ROOT, env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"dist-soak timed out after {timeout_s}s")
+        return 1
+    print("dist-soak:", "OK" if rc == 0 else f"FAILED (rc={rc})")
+    return rc
+
+
 def sanitize(timeout_s: int = 300, json_out: bool = False) -> int:
     """Run every soak under the runtime concurrency sanitizer
-    (tools/graftsan, GRAFTSAN=1): chaos_soak --flow and --gateway,
-    fleet_soak, train_soak.  Each job fails on any unsuppressed S-rule
+    (tools/graftsan, GRAFTSAN=1): chaos_soak --flow / --gateway /
+    --dist, fleet_soak, train_soak, dist_soak.  Each job fails on any
+    unsuppressed S-rule
     finding (lockset race S101, lock-order cycle S201, credit/EOF leak
     S301, leaked fault-point arm S302) not excused by the checked-in —
     and deliberately empty — tools/graftsan_baseline.json."""
@@ -425,6 +450,8 @@ def sanitize(timeout_s: int = 300, json_out: bool = False) -> int:
         ("fleet", [os.path.join("tools", "fleet_soak.py")]),
         ("obs", [os.path.join("tools", "fleet_soak.py"), "--obs"]),
         ("train", [os.path.join("tools", "train_soak.py")]),
+        ("chaos-dist", [os.path.join("tools", "chaos_soak.py"), "--dist"]),
+        ("dist", [os.path.join("tools", "dist_soak.py")]),
     ]
     failures = 0
     for name, cmd in jobs:
@@ -449,7 +476,8 @@ def main(argv=None):
     ap.add_argument("command", choices=["lint", "metrics-lint", "test",
                                         "perf-gate", "fleet-smoke",
                                         "obs-soak", "train-soak",
-                                        "flow-soak", "feed-bench",
+                                        "flow-soak", "dist-soak",
+                                        "feed-bench",
                                         "parity-3d", "sanitize", "all"])
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard", type=int, default=-1,
@@ -487,6 +515,8 @@ def main(argv=None):
         return train_smoke()
     if args.command == "flow-soak":
         return flow_soak()
+    if args.command == "dist-soak":
+        return dist_soak()
     if args.command == "feed-bench":
         return feed_bench_smoke()
     if args.command == "parity-3d":

@@ -1,0 +1,554 @@
+"""Elastic multi-host soak: kill a host mid-epoch, survivors quarantine,
+shrink the mesh, and resume from the last verified checkpoint.
+
+Two legs, together covering the whole elastic ladder
+(docs/robustness.md "Elastic multi-host"):
+
+* **Leg A — elastic shrink (in-process).**  The 8-device virtual CPU
+  mesh is partitioned into 4 simulated hosts (`host_device_groups`).
+  Host h3's heartbeats stop mid-run; the coordinator's
+  `HeartbeatMonitor` (driven on a `VirtualClock`, so lease expiry is
+  scripted) declares it lost, and `fit_epochs_resumable`'s elastic
+  ladder runs for real: `guard.host_lost` ledgers the peer into
+  quarantine.json, the state rolls back to the checkpoint floor, the
+  membership epoch advances, and the rebuild callback re-runs the mesh
+  over the survivors' 6 devices — the data axis actually shrinks 8→6
+  and training completes on the smaller mesh.  Asserts: exactly-once
+  step ledger (every schedule position trained once in the surviving
+  trajectory, bounded replay), final params match an uninterrupted
+  8-device reference within float tolerance (collective reduction
+  order changes with the mesh, so parity is allclose, not bit-exact),
+  finite losses, `dist.host.lost == 1`.
+* **Leg B — pod kill (3 real processes).**  Three workers (2 virtual
+  CPU devices each) rendezvous through the file-based
+  `MembershipStore` plane — the CPU stand-in for the jax coordination
+  service, since CPU XLA cannot run cross-process collectives — then
+  train in lock-step data-parallel simulation (identical math per
+  host), each beating its lease and serving `/metrics.json` from a
+  `HostTelemetryServer`.  The parent SIGKILLs host2 mid-epoch while
+  every worker holds at a choreographed step (still beating, so the
+  kill is the ONLY silence).  The coordinator's lease monitor detects
+  the death, publishes the shrunken epoch-2 view; the follower adopts
+  it from the store; both survivors roll back to the last verified
+  checkpoint and finish the schedule.  The parent then scrapes the
+  survivors' live telemetry endpoints and federates them with
+  `merge_snapshots` — asserting the pod-level view converges: exactly
+  one `dist.host.lost` across the fleet, both survivors on membership
+  epoch 2, exactly-once ledgers, quarantine.json on every survivor.
+
+Runs entirely on CPU (tools/ci.py `dist-soak`).  Exit 0 ⇒ every
+invariant held.
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+# schedule geometry shared by both legs: 96 rows / batch 24 = 4 steps
+# per epoch, 4 epochs = 16 steps, checkpoint floor every 2
+N_ROWS, BATCH, EPOCHS, CKPT_EVERY = 96, 24, 4, 2
+TOTAL_STEPS = EPOCHS * (N_ROWS // BATCH)
+# leg B worker geometry (2 devices per host): batch 16 over 64 rows
+POD_ROWS, POD_BATCH = 64, 16
+POD_TOTAL = EPOCHS * (POD_ROWS // POD_BATCH)
+HOLD_STEP = 6          # schedule position every pod worker holds at
+POD_NPROC = 3
+POD_LEASE_S = 2.0      # >> the 0.2s beater period; silence == death
+
+
+def _setup(n_rows, batch, mesh=None, lr: float = 0.1):
+    """Tiny model + data + step builder (mirrors tools/train_soak.py)."""
+    import flax.linen as nn
+    import optax
+
+    from mmlspark_tpu.models.training import (init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x), {}
+
+    model = M()
+    mesh = mesh or default_mesh()
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(n_rows, 4, 4, 1)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=n_rows)
+
+    def make_step(m):
+        return make_train_step(model, optax.sgd(lr), 4, mesh=m,
+                               donate=False)
+
+    def fresh():
+        return init_train_state(model, optax.sgd(lr), (4, 4, 1), seed=0)
+
+    return model, mesh, imgs, lbls, make_step, fresh
+
+
+def _surviving_trajectory(positions):
+    """Collapse an executed-position log into the final trajectory: a
+    replayed position overwrites everything it rolled back over.  The
+    exactly-once ledger == the trajectory is each position once, in
+    order; the difference from the raw log is the bounded replay."""
+    traj = []
+    for p in positions:
+        while traj and traj[-1] >= p:
+            traj.pop()
+        traj.append(p)
+    return traj
+
+
+def _assert_ledger(positions, total, events: int = 1):
+    traj = _surviving_trajectory(positions)
+    assert traj == list(range(total)), (
+        f"step ledger is not exactly-once over the schedule: "
+        f"trajectory {traj} != 0..{total - 1}")
+    replayed = len(positions) - total
+    bound = events * (CKPT_EVERY + 2)
+    assert 0 <= replayed <= bound, (
+        f"replay window too large: {replayed} replayed steps > {bound}")
+    return replayed
+
+
+# ---------------------------------------------------------------------------
+# Leg A: in-process elastic shrink on simulated hosts
+# ---------------------------------------------------------------------------
+
+def run_elastic(workdir, seed: int = 7) -> dict:
+    import jax
+
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.models.guard import TrainingGuard
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+    from mmlspark_tpu.parallel import distributed as dist
+    from mmlspark_tpu.parallel.mesh import host_device_groups, make_mesh
+    from mmlspark_tpu.utils.faults import VirtualClock
+
+    host_ids = ["h0", "h1", "h2", "h3"]
+    groups = host_device_groups(jax.devices(), len(host_ids))
+    hosts = [dist.HostInfo(h, i, len(groups[i]))
+             for i, h in enumerate(host_ids)]
+    model, _, imgs, lbls, make_step, fresh = _setup(N_ROWS, BATCH)
+    full_mesh = make_mesh(devices=jax.devices())
+
+    # uninterrupted 8-device reference: the parity baseline
+    ref, _ = fit_epochs_resumable(
+        make_step(full_mesh), fresh(), imgs, lbls, batch_size=BATCH,
+        checkpoint_dir=str(Path(workdir) / "ref"), epochs=EPOCHS,
+        checkpoint_every=CKPT_EVERY, mesh=full_mesh, seed=seed)
+    assert int(ref.step) == TOTAL_STEPS
+
+    c0 = dict(telemetry.counters("dist."))
+    clock = VirtualClock()
+    mon = dist.HeartbeatMonitor(host_ids, lease_s=1.0,
+                                clock=clock.monotonic, self_id="h0")
+    rebuilds = []
+
+    def rebuild(view):
+        devs = [d for i, h in enumerate(host_ids)
+                if h in view.host_ids for d in groups[i]]
+        mesh = make_mesh(devices=devs)
+        rebuilds.append(mesh.shape["data"])
+        return mesh, make_step(mesh)
+
+    view = dist.MembershipView(1, hosts)
+    ctx = dist.ElasticContext(hosts[0], view, monitor=mon,
+                              coordinator=True, rebuild=rebuild,
+                              hang_budget_s=120.0)
+    positions = []
+    kill_at = 7  # h3's last beat lands at optimizer step 6
+
+    def log_fn(step, metrics):
+        positions.append(step - 1)  # state.step is position + 1
+        assert np.isfinite(metrics["loss"]), \
+            f"non-finite loss at step {step}"
+        # simulated peers beat once per step; h3 goes silent mid-epoch
+        clock.advance(0.4)
+        mon.beat("h1")
+        mon.beat("h2")
+        if step < kill_at:
+            mon.beat("h3")
+
+    guard = TrainingGuard(watchdog=False)
+    ckpt = Path(workdir) / "elastic"
+    state, metrics = fit_epochs_resumable(
+        make_step(full_mesh), fresh(), imgs, lbls, batch_size=BATCH,
+        checkpoint_dir=str(ckpt), epochs=EPOCHS,
+        checkpoint_every=CKPT_EVERY, mesh=full_mesh, seed=seed,
+        log_fn=log_fn, guard=guard, elastic=ctx)
+    c1 = dict(telemetry.counters("dist."))
+
+    def delta(name):
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    assert delta("dist.host.lost") == 1, (
+        f"dist.host.lost fired {delta('dist.host.lost')} times, want 1")
+    assert [r["host_id"] for r in guard.lost_hosts] == ["h3"]
+    assert mon.lost["h3"]["kind"] == "lease_expired"
+    assert ctx.view.epoch == 2 and ctx.view.host_ids == ["h0", "h1", "h2"]
+    assert rebuilds == [6], (
+        f"data axis after shrink: {rebuilds}, want [6] (8 devices - h3)")
+    assert int(state.step) == TOTAL_STEPS
+    assert np.isfinite(metrics["loss"])
+    replayed = _assert_ledger(positions, TOTAL_STEPS)
+    assert replayed >= 1, "the loss never rolled anything back"
+    qdoc = json.loads((ckpt / "quarantine.json").read_text())
+    assert [r["host_id"] for r in qdoc["lost_hosts"]] == ["h3"]
+    # host loss is not a data anomaly: no rollback budget, no lr backoff
+    assert guard.rollbacks == 0 and guard.lr_scale == 1.0
+    # trajectory parity with the uninterrupted reference: allclose, not
+    # bit-exact — the 6-device mesh reduces in a different order
+    import jax as _jax
+    for a, b in zip(_jax.tree.leaves(ref.params),
+                    _jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)
+    return {
+        "lost": "h3",
+        "detected_by": "lease_expiry",
+        "epoch": ctx.view.epoch,
+        "data_axis_after": rebuilds[0],
+        "steps": int(state.step),
+        "replayed_steps": replayed,
+        "final_loss": metrics["loss"],
+        "params_match_reference": True,
+        "counters": {k: delta(k) for k in (
+            "dist.host.lost", "dist.host.lost.h3",
+            "dist.membership.stale")},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Leg B: a 3-process pod, one SIGKILLed mid-epoch
+# ---------------------------------------------------------------------------
+
+def _write_json(path: Path, doc: dict) -> None:
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps(doc))
+    os.replace(tmp, path)
+
+
+def _read_json(path: Path):
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def run_worker(args) -> int:
+    """One pod host (invoked with --worker): rendezvous on the file
+    plane, train with an ElasticContext, hold at HOLD_STEP while the
+    parent kills a peer, survive the loss, publish telemetry, report."""
+    import jax
+
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.models.guard import TrainingGuard
+    from mmlspark_tpu.models.training import fit_epochs_resumable
+    from mmlspark_tpu.parallel import distributed as dist
+
+    root = Path(args.root)
+    host_id, rank = args.id, args.rank
+    coordinator = rank == 0
+    store = dist.MembershipStore(root / "plane")
+    info = dist.HostInfo(host_id, rank, jax.local_device_count())
+    view = store.rendezvous(info, expected=args.nproc,
+                            coordinator=coordinator, timeout_s=60.0)
+    srv = dist.HostTelemetryServer(host_id)
+    host, port = srv.start()
+    _write_json(root / "ports" / f"{host_id}.json",
+                {"host_id": host_id, "host": host, "port": port})
+
+    # beat from a dedicated thread, the way a real runtime does: a jit
+    # compile or an orbax restore must never read as a death — only
+    # actual process silence (SIGKILL takes the daemon thread with it)
+    import threading
+    stop_beat = threading.Event()
+
+    def _beater():
+        while not stop_beat.wait(0.2):
+            store.heartbeat(host_id)
+
+    threading.Thread(target=_beater, daemon=True,
+                     name="dist-soak-beater").start()
+
+    mon = None
+    if coordinator:
+        mon = dist.HeartbeatMonitor(view.host_ids, lease_s=POD_LEASE_S,
+                                    source=store.read_beats,
+                                    self_id=host_id)
+    ctx = dist.ElasticContext(info, view, store=store, monitor=mon,
+                              coordinator=coordinator, hang_budget_s=60.0)
+
+    def hold():
+        """Everyone pauses at the same step, STILL beating, so the
+        parent's SIGKILL is the only host that ever goes silent."""
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            store.heartbeat(host_id)
+            if coordinator:
+                mon.ingest(store.read_beats())
+                mon.check_now()
+                if mon.lost:
+                    return  # the death is detected: resume training
+            else:
+                latest = store.load()
+                if latest is not None and latest.epoch > 1:
+                    return  # coordinator published the shrunken view
+            time.sleep(0.1)
+        raise RuntimeError(f"{host_id}: hold timed out — no peer death "
+                           f"observed within 90s")
+
+    held = {"done": False}
+    positions = []
+
+    def log_fn(step, metrics):
+        positions.append(step - 1)
+        _write_json(root / "progress" / f"{host_id}.json",
+                    {"host_id": host_id, "step": step})
+        if step == HOLD_STEP and not held["done"]:
+            held["done"] = True
+            hold()
+
+    _, mesh, imgs, lbls, make_step, fresh = _setup(POD_ROWS, POD_BATCH)
+    guard = TrainingGuard(watchdog=False)
+    ckpt = root / "ckpt" / host_id
+    state, metrics = fit_epochs_resumable(
+        make_step(mesh), fresh(), imgs, lbls, batch_size=POD_BATCH,
+        checkpoint_dir=str(ckpt), epochs=EPOCHS,
+        checkpoint_every=CKPT_EVERY, mesh=mesh, seed=args.seed,
+        log_fn=log_fn, guard=guard, elastic=ctx)
+
+    lost = [r["host_id"] for r in guard.lost_hosts]
+    ok = bool(lost) and ctx.view.epoch == 2 \
+        and int(state.step) == POD_TOTAL \
+        and bool(np.isfinite(metrics["loss"]))
+    _write_json(root / "out" / f"{host_id}.json", {
+        "host_id": host_id,
+        "ok": ok,
+        "steps": int(state.step),
+        "final_loss": float(metrics["loss"]),
+        "lost_hosts": lost,
+        "epoch": ctx.view.epoch,
+        "positions": positions,
+        "counters": dict(telemetry.counters("dist.")),
+    })
+    # keep the telemetry endpoint alive until the parent has scraped it
+    deadline = time.monotonic() + 60.0
+    while not (root / "RELEASE").exists():
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    stop_beat.set()
+    srv.stop()
+    return 0 if ok else 3
+
+
+def run_pod(workdir, seed: int = 7) -> dict:
+    """Parent side of leg B: spawn the pod, SIGKILL host2 mid-epoch,
+    assert the survivors' reports + the federated telemetry view."""
+    from mmlspark_tpu.core.telemetry.fleet import merge_snapshots
+
+    root = Path(workdir)
+    for d in ("ports", "progress", "out", "logs"):
+        (root / d).mkdir(parents=True, exist_ok=True)
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               GRAFTSAN="0")
+    procs, logs = [], []
+    for rank in range(POD_NPROC):
+        log = open(root / "logs" / f"host{rank}.log", "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--id", f"host{rank}", "--rank", str(rank),
+             "--nproc", str(POD_NPROC), "--root", str(root),
+             "--seed", str(seed)],
+            stdout=log, stderr=subprocess.STDOUT, env=env))
+
+    def fail(msg):
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        tails = {}
+        for rank in range(POD_NPROC):
+            logs[rank].flush()
+            text = (root / "logs" / f"host{rank}.log").read_text()
+            tails[f"host{rank}"] = text[-2000:]
+        raise AssertionError(f"{msg}\nworker logs: "
+                             f"{json.dumps(tails, indent=2)}")
+
+    try:
+        # wait for the victim to reach its hold step, then SIGKILL it
+        deadline = time.monotonic() + 240.0
+        victim = procs[POD_NPROC - 1]
+        while True:
+            prog = _read_json(root / "progress"
+                              / f"host{POD_NPROC - 1}.json")
+            if prog and prog["step"] >= HOLD_STEP:
+                break
+            if victim.poll() is not None:
+                fail("victim worker exited before the kill step")
+            if time.monotonic() > deadline:
+                fail("victim never reached the hold step")
+            time.sleep(0.1)
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30)
+        (root / "KILLED").write_text(f"host{POD_NPROC - 1}\n")
+
+        survivors = [f"host{r}" for r in range(POD_NPROC - 1)]
+        deadline = time.monotonic() + 240.0
+        reports = {}
+        while len(reports) < len(survivors):
+            for h in survivors:
+                if h not in reports:
+                    doc = _read_json(root / "out" / f"{h}.json")
+                    if doc is not None:
+                        reports[h] = doc
+            for rank, h in enumerate(survivors):
+                if h not in reports and procs[rank].poll() is not None:
+                    fail(f"survivor {h} died before reporting")
+            if time.monotonic() > deadline:
+                fail(f"survivors never reported: "
+                     f"{sorted(set(survivors) - set(reports))}")
+            time.sleep(0.1)
+
+        # scrape each survivor's LIVE per-host endpoint and federate
+        snaps = {}
+        for h in survivors:
+            port = _read_json(root / "ports" / f"{h}.json")["port"]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics.json",
+                    timeout=10) as r:
+                snaps[h] = json.load(r)
+    finally:
+        (root / "RELEASE").write_text("done\n")
+        rcs = {}
+        for rank, p in enumerate(procs):
+            try:
+                rcs[f"host{rank}"] = p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[f"host{rank}"] = p.wait()
+        for log in logs:
+            log.close()
+
+    victim_id = f"host{POD_NPROC - 1}"
+    assert rcs[victim_id] == -signal.SIGKILL, (
+        f"victim exit code {rcs[victim_id]} != SIGKILL")
+    for h in survivors:
+        assert rcs[h] == 0, f"survivor {h} exited {rcs[h]}"
+        rep = reports[h]
+        assert rep["ok"], f"{h} report flagged failure: {rep}"
+        assert rep["steps"] == POD_TOTAL
+        assert np.isfinite(rep["final_loss"])
+        assert rep["lost_hosts"] == [victim_id], (
+            f"{h} ledgered {rep['lost_hosts']}, want [{victim_id!r}]")
+        assert rep["epoch"] == 2
+        _assert_ledger(rep["positions"], POD_TOTAL)
+        qdoc = _read_json(root / "ckpt" / h / "quarantine.json")
+        assert qdoc and [r["host_id"] for r in qdoc["lost_hosts"]] \
+            == [victim_id], f"{h} quarantine.json missing the loss"
+        # every survivor's own endpoint converged on membership epoch 2
+        assert snaps[h]["gauges"]["dist.membership.epoch"] == 2.0, (
+            f"{h} gauge dist.membership.epoch = "
+            f"{snaps[h]['gauges'].get('dist.membership.epoch')}")
+
+    merged = merge_snapshots(snaps)
+    mc = merged["counters"]
+    # exactly one death across the whole pod (only the coordinator's
+    # monitor announces; the follower adopts the published epoch)
+    assert mc.get("dist.host.lost", 0) == 1, (
+        f"fleet dist.host.lost = {mc.get('dist.host.lost')}, want 1")
+    assert mc.get(f"dist.host.lost.{victim_id}", 0) == 1
+    assert mc.get("dist.rendezvous.attempt", 0) >= len(survivors), (
+        "rendezvous attempts missing from the federated view")
+    assert mc.get("dist.membership.update", 0) >= 2, (
+        "epoch-1 + epoch-2 publishes missing from the federated view")
+    return {
+        "nproc": POD_NPROC,
+        "killed": victim_id,
+        "survivors": {h: {"steps": reports[h]["steps"],
+                          "final_loss": reports[h]["final_loss"],
+                          "epoch": reports[h]["epoch"],
+                          "replayed_steps":
+                              len(reports[h]["positions"]) - POD_TOTAL}
+                      for h in survivors},
+        "fleet_counters": {k: mc[k] for k in sorted(mc)
+                           if k.startswith("dist.")},
+    }
+
+
+def main(argv=None):
+    import tempfile
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a tempdir)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON object")
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--id", help=argparse.SUPPRESS)
+    ap.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--nproc", type=int, default=POD_NPROC,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--root", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.worker:
+        return run_worker(args)
+    import tools.graftsan as graftsan
+
+    sanitizing = graftsan.soak_install()
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as tmp:
+        work = Path(args.workdir or tmp)
+        elastic = run_elastic(work / "elastic", seed=args.seed)
+        pod = run_pod(work / "pod", seed=args.seed)
+    summary = {"elastic": elastic, "pod": pod,
+               "wall_s": round(time.monotonic() - t0, 2)}
+    rc = 0
+    san_text = ""
+    if sanitizing:
+        san_text, san_ok = graftsan.report(json_out=args.json)
+        if args.json:
+            summary["graftsan"] = json.loads(san_text)
+        if not san_ok:
+            rc = 1
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(f"dist soak OK: elastic leg lost {elastic['lost']} "
+              f"(lease expiry), shrank data axis 8->"
+              f"{elastic['data_axis_after']}, replayed "
+              f"{elastic['replayed_steps']} steps, params match the "
+              f"reference; pod leg killed {pod['killed']} of "
+              f"{pod['nproc']}, survivors finished "
+              f"{POD_TOTAL} steps on epoch 2, fleet saw "
+              f"{pod['fleet_counters'].get('dist.host.lost')} host "
+              f"loss in {summary['wall_s']}s")
+    if sanitizing and not args.json:
+        print(san_text)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
