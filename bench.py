@@ -35,6 +35,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_FILE = os.path.join(HERE, "BENCH_BASELINE.json")
 LASTGOOD_FILE = os.path.join(HERE, "BENCH_LASTGOOD.json")
 
+# Stamped into every record as "schema"; tools/perf_gate.py cross-checks it
+# against BENCH_LASTGOOD.json and flags a STALE BASELINE on mismatch.  Bump
+# whenever the record's key set or the methodology behind a gated metric
+# changes, so a pre-change baseline can't silently gate the new numbers.
+BENCH_SCHEMA = 2
+
 BATCH = 128
 # the e2e feed batches large: through a tunneled chip the fixed per-transfer
 # cost dominates, and on a real host bigger device_put chunks amortize too
@@ -271,6 +277,91 @@ def _measure_guard(steps: int = 96, batch: int = 32,
     return {
         "guard_overhead_frac": max(0.0, round((disabled - ref) / ref, 4)),
         "guard_enabled_overhead_frac": round((enabled - ref) / ref, 4),
+    }
+
+
+def _measure_timeseries_overhead(steps: int = 96, batch: int = 32,
+                                 reps: int = 5) -> dict:
+    """Per-step cost of the goodput plane (PR 20): the
+    `LEDGER.record_step` + `STORE.tick` pair fit_epochs_resumable now
+    executes every step.  The contract is <1% of step wall — perf_gate
+    bands `timeseries_overhead_frac` absolutely at one point, same shape
+    as the guard/sanitizer disabled-path contracts.  Measured as an
+    interleaved min-of-medians of the identical feed+step body with and
+    without the two calls (methodology of _measure_guard)."""
+    import statistics
+
+    import jax
+    import optax
+    import flax.linen as nn
+    import numpy as np
+
+    from mmlspark_tpu.core import telemetry as core_telemetry
+    from mmlspark_tpu.core.telemetry.goodput import GoodputLedger
+    from mmlspark_tpu.core.telemetry.timeseries import TimeSeriesStore
+    from mmlspark_tpu.io.feed import DeviceFeed
+    from mmlspark_tpu.models.training import (init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import batch_sharding, default_mesh
+
+    class M(nn.Module):
+        # same sizing rationale as _measure_guard: the denominator must
+        # be a real 1-3 ms step, not a microsecond no-op
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(256)(x))
+            return nn.Dense(4)(x), {}
+
+    mesh = default_mesh()
+    model, opt = M(), optax.sgd(0.1)
+    n = steps * batch
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(n, 16, 16, 3)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=n).astype(np.int32)
+    step = make_train_step(model, opt, 4, mesh=mesh, donate=False)
+    state0 = init_train_state(model, opt, (16, 16, 3), seed=0)
+    img_sh = batch_sharding(mesh, 4)
+    lbl_sh = batch_sharding(mesh, 1)
+    jax.block_until_ready(step(state0, imgs[:batch], lbls[:batch])[1]["loss"])
+
+    led = GoodputLedger(host_id="bench")
+    store = TimeSeriesStore()
+
+    def median_step_s(times):
+        deltas = [b - a for a, b in zip(times, times[1:])]
+        return statistics.median(deltas[2:])  # drop warm-in steps
+
+    def run(instrumented):
+        order = np.random.default_rng([7, 0]).permutation(n)
+        feed = DeviceFeed(mesh=mesh)
+        state, times = state0, []
+        led.reset("bench")
+        store.reset()
+        for g in range(steps):
+            idx = order[g * batch:(g + 1) * batch]
+            dbi, dbl = feed.put_group([imgs[idx], lbls[idx]],
+                                      shardings=(img_sh, lbl_sh))
+            t0 = time.perf_counter()
+            state, m = step(state, dbi, dbl)
+            metrics = {k: float(v) for k, v in m.items()}
+            dt = time.perf_counter() - t0
+            core_telemetry.histogram(
+                "models.training.step_latency").observe(dt)
+            if instrumented:
+                led.record_step(g, compute_s=dt, h2d=0.0)
+                store.tick()
+            _ = (int(state.step), metrics)
+            times.append(time.perf_counter())
+        return median_step_s(times)
+
+    refs, ins = [], []
+    for _ in range(reps):
+        refs.append(run(False))
+        ins.append(run(True))
+    ref, inst = min(refs), min(ins)
+    return {
+        "timeseries_overhead_frac": max(0.0, round((inst - ref) / ref, 4)),
     }
 
 
@@ -517,7 +608,7 @@ def _lm3d_child():
 
     out = {"lm3d_layouts": {}, "grad_accum_steps": None}
     flops_step = 0.0
-    best_ms = None
+    best_ms, best_exec = None, None
     for (d, t, p), (a, m) in LM3D_LAYOUTS:
         plan = MeshPlan(data=d, model=t, pipe=p)
         p3 = shard_params(lm_params_to_3d(params, L, p), plan.mesh,
@@ -539,8 +630,22 @@ def _lm3d_child():
         out["lm3d_layouts"][f"{d}x{t}x{p}"] = round(ms, 2)
         out["grad_accum_steps"] = a
         if best_ms is None or ms < best_ms:
-            best_ms = ms
+            best_ms, best_exec = ms, (compiled, p3, os3, tb)
     out["lm3d_step_ms"] = round(best_ms, 2)
+
+    # goodput-plane rider (PR 20): a few explicitly timed steps of the
+    # winning layout through a fresh ledger, so the sweep record carries
+    # goodput_frac and the lost-time table alongside step_ms
+    from mmlspark_tpu.core.telemetry.goodput import GoodputLedger
+    led = GoodputLedger(host_id="lm3d")
+    compiled_b, pb, ob, tbb = best_exec
+    for i in range(4):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled_b(pb, ob, tbb)[2]["loss"])
+        led.record_step(i, compute_s=time.perf_counter() - t0)
+    summ = led.summary()
+    out["goodput_frac"] = summ["goodput_frac"]
+    out["lost_time_breakdown"] = summ["lost"]
     peak = _chip_peak_flops()
     out["lm_train_mfu_3d"] = (round(flops_step / (best_ms / 1e3) / peak, 4)
                               if peak and flops_step else None)
@@ -884,6 +989,10 @@ def _child_measure():
     except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
         san = {"sanitizer_error": str(e)[-200:]}
     try:
+        ts = _measure_timeseries_overhead()
+    except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
+        ts = {"timeseries_error": str(e)[-200:]}
+    try:
         fleet = _measure_fleet_scrape()
     except Exception as e:  # noqa: BLE001 — secondary metric, never fatal
         fleet = {"fleet_scrape_error": str(e)[-200:]}
@@ -895,7 +1004,7 @@ def _child_measure():
         include_spans=False,
         timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     print(json.dumps({"res": res, "train": train, "vit": vit, "lm": lm,
-                      "lm3d": lm3d, "guard": guard, "san": san,
+                      "lm3d": lm3d, "guard": guard, "san": san, "ts": ts,
                       "fleet": fleet, "obs": obs}))
 
 
@@ -1049,10 +1158,13 @@ def main():
            if v is not None},
         **{k: v for k, v in child.get("san", {}).items()
            if v is not None},
+        **{k: v for k, v in child.get("ts", {}).items()
+           if v is not None},
         **{k: v for k, v in child.get("fleet", {}).items()
            if v is not None},
         "device_kind": res["device_kind"],
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "schema": BENCH_SCHEMA,
     }
     if res["platform"] != "cpu":  # only chip runs count as "good"
         with open(LASTGOOD_FILE, "w") as f:

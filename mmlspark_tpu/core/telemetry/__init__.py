@@ -29,6 +29,12 @@ New surface (see docs/observability.md):
   sentry), ``sample_device_memory()`` / ``start_memory_sampler()`` (HBM
   + live-buffer gauges), ``enable_device_annotations()`` (opt-in
   ``jax.profiler.TraceAnnotation`` on stage spans).
+* goodput plane — ``STORE`` (:class:`timeseries.TimeSeriesStore`,
+  bounded recent history with rate/delta/quantile-over-time) and
+  ``LEDGER`` (:class:`goodput.GoodputLedger`, per-step timelines +
+  lost-time attribution), federated by ``merge_timeseries_exports`` /
+  ``merge_goodput_exports`` and served in the ``timeseries`` /
+  ``goodput`` blocks of ``export_snapshot()``.
 """
 from __future__ import annotations
 
@@ -76,10 +82,20 @@ from .fleet import (
     SLOEngine,
     default_slos,
     merge_snapshots,
+    merge_goodput_exports,
     merge_histogram_snapshots,
+    merge_timeseries_exports,
     render_fleet_prometheus,
     stitch_spans,
 )
+from .goodput import (
+    GoodputLedger,
+    LEDGER,
+    LOST_KINDS,
+    StepTimeline,
+    detect_straggler,
+)
+from .timeseries import SAMPLED_SERIES, STORE, TimeSeriesStore
 from .device import (
     SENTRY,
     CompileSentry,
@@ -113,8 +129,13 @@ __all__ = [
     "format_span_tree", "format_latency_table",
     # fleet federation (merge / stitch / SLO / incidents)
     "merge_snapshots", "merge_histogram_snapshots",
+    "merge_timeseries_exports", "merge_goodput_exports",
     "render_fleet_prometheus", "stitch_spans", "SLO", "SLOEngine",
     "default_slos", "FlightRecorder",
+    # goodput plane (timeseries engine + lost-time ledger, PR 20)
+    "TimeSeriesStore", "STORE", "SAMPLED_SERIES",
+    "GoodputLedger", "StepTimeline", "LEDGER", "LOST_KINDS",
+    "detect_straggler",
     # device (compile sentry, memory gauges, annotations)
     "SENTRY", "CompileSentry", "track_compiles", "watch_compiles",
     "sample_device_memory", "MemorySampler", "start_memory_sampler",

@@ -52,6 +52,7 @@ import threading
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 from . import spans as _spans
+from .goodput import LEDGER
 from .metrics import REGISTRY
 from .records import log_verb, logger
 
@@ -181,6 +182,10 @@ class CompileSentry:
             REGISTRY.histogram("xla.compile.latency").observe(float(duration))
             _spans.record_span("xla.compile", _spans.current_context(),
                                float(duration), phase=phase)
+            # a compile observed after training started is wall the run
+            # can never get back — the goodput ledger drops this until
+            # its first recorded step, so warmup stays unattributed
+            LEDGER.note_lost("recompile", float(duration))
             if steady:
                 REGISTRY.incr("xla.compile.hot_path")
                 logger.warning(

@@ -17,7 +17,9 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from .metrics import REGISTRY, MetricsRegistry
+from . import goodput as _goodput
 from . import spans as _spans
+from . import timeseries as _timeseries
 
 __all__ = ["render_prometheus", "export_snapshot", "render_chrome_trace",
            "format_span_tree", "format_latency_table", "sanitize_name"]
@@ -165,6 +167,16 @@ def export_snapshot(registry: MetricsRegistry = REGISTRY,
     }
     if include_spans:
         out["spans"] = [_safe_span(r) for r in _spans.recent_spans()]
+    # the goodput plane (PR 20): recent history + per-step timelines,
+    # only when the process actually produced any — idle servers keep
+    # the legacy snapshot shape byte-for-byte
+    if registry is REGISTRY:
+        ts = _timeseries.STORE.export()
+        if ts["series"]:
+            out["timeseries"] = ts
+        gp = _goodput.LEDGER.export()
+        if gp["steps"] or gp["summary"]["lost"] or gp["summary"]["productive_s"]:
+            out["goodput"] = gp
     return out
 
 

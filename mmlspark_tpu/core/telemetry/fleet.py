@@ -44,11 +44,13 @@ from typing import (Any, Callable, Dict, Iterable, List, Mapping, Optional,
 
 from ...utils.faults import monotonic as _monotonic
 from ...utils.sync import make_lock
+from .goodput import detect_straggler
 from .metrics import REGISTRY
 from .exposition import sanitize_name
 
 __all__ = [
     "parse_hist_key", "merge_histogram_snapshots", "merge_snapshots",
+    "merge_timeseries_exports", "merge_goodput_exports",
     "federate_host_snapshots",
     "hist_total", "cum_le", "render_fleet_prometheus", "stitch_spans",
     "SLO", "SLOEngine", "default_slos", "FlightRecorder",
@@ -150,6 +152,112 @@ def merge_histogram_snapshots(snaps: Sequence[Mapping[str, Any]],
     }
 
 
+def merge_timeseries_exports(sources: Mapping[str, Mapping[str, Any]]
+                             ) -> Dict[str, Any]:
+    """Exact merge of per-host `TimeSeriesStore.export()` blocks, with
+    the same strictness as histogram merges: a series whose kind or
+    sampling cadence differs across hosts raises instead of merging
+    inexactly (the timeseries twin of bucket-edge drift).
+
+    Counter series are summed on the cadence-aligned bucket grid, and
+    only on buckets where EVERY host contributed a sample — a partial
+    bucket would under-count, which is exactly the silent-wrongness the
+    histogram plane refuses.  Gauge series are never summed (same rule
+    as `merge_snapshots`); per-host points are kept verbatim under
+    ``by_host`` for both kinds.  Timestamps are each host's monotonic
+    clock, so the merged grid is exact per host and comparable across
+    hosts only to within clock skew.
+    """
+    series: Dict[str, Dict[str, Any]] = {}
+    cadence: Optional[float] = None
+    for host in sorted(sources):
+        exp = sources[host] or {}
+        host_cad = exp.get("cadence_s")
+        for name, s in sorted((exp.get("series") or {}).items()):
+            ent = series.setdefault(name, {"kind": s.get("kind"),
+                                           "cadence_s": host_cad,
+                                           "by_host": {}})
+            if s.get("kind") != ent["kind"]:
+                raise ValueError(
+                    f"timeseries {name!r}: kind differs across hosts "
+                    f"({ent['kind']!r} vs {s.get('kind')!r}) — merge "
+                    f"would be inexact")
+            if host_cad != ent["cadence_s"]:
+                raise ValueError(
+                    f"timeseries {name!r}: sampling cadence differs "
+                    f"across hosts ({ent['cadence_s']!r} vs "
+                    f"{host_cad!r}) — merge would be inexact")
+            ent["by_host"][host] = [
+                (float(t), float(v)) for t, v in (s.get("points") or [])]
+        if host_cad is not None:
+            cadence = host_cad
+    for name, ent in series.items():
+        if ent["kind"] != "counter":
+            ent["merged"] = None
+            continue
+        cad = float(ent["cadence_s"] or 1.0)
+        hosts = set(ent["by_host"])
+        buckets: Dict[int, Dict[str, float]] = {}
+        for host, pts in ent["by_host"].items():
+            for t, v in pts:
+                # last sample in a bucket wins (cumulative counters:
+                # the latest value subsumes earlier ones)
+                buckets.setdefault(int(math.floor(t / cad)), {})[host] = v
+        ent["merged"] = [
+            [b * cad, sum(by.values())]
+            for b, by in sorted(buckets.items()) if set(by) == hosts]
+    return {"hosts": sorted(sources), "cadence_s": cadence,
+            "series": series}
+
+
+def merge_goodput_exports(sources: Mapping[str, Mapping[str, Any]],
+                          straggler_ratio: float = 2.0,
+                          straggler_streak: int = 3) -> Dict[str, Any]:
+    """Fold per-host `GoodputLedger.export()` blocks into the federated
+    goodput view: per-host summaries, a fleet lost-time table (summed —
+    lost seconds are additive across hosts, like counters), the fleet
+    goodput fraction (Σ productive / Σ wall), and straggler detection
+    over the per-host step timelines.
+
+    A named straggler is surfaced on THIS process's registry — a
+    ``training.straggler`` (+ ``.<host>``) counter and the
+    ``training.straggler.ratio`` gauge — so the SLOEngine and scrapers
+    of the merging process (gateway or soak parent) see it without
+    consuming the merged dict."""
+    hosts: Dict[str, Any] = {}
+    lost: Dict[str, float] = {}
+    productive = wall = 0.0
+    timelines: Dict[str, Sequence[Mapping[str, Any]]] = {}
+    for host in sorted(sources):
+        exp = sources[host] or {}
+        summ = dict(exp.get("summary") or {})
+        steps = list(exp.get("steps") or [])
+        hosts[host] = {"summary": summ, "steps": steps}
+        for kind, v in (summ.get("lost") or {}).items():
+            lost[kind] = lost.get(kind, 0.0) + float(v)
+        productive += float(summ.get("productive_s") or 0.0)
+        wall += float(summ.get("wall_s") or 0.0)
+        timelines[host] = steps
+    straggler = detect_straggler(timelines, ratio=straggler_ratio,
+                                 streak=straggler_streak)
+    if straggler is not None:
+        REGISTRY.incr("training.straggler")
+        REGISTRY.incr(f"training.straggler.{straggler['host']}")
+        REGISTRY.gauge("training.straggler.ratio").set(
+            float(straggler["ratio"]))
+    return {
+        "hosts": hosts,
+        "fleet": {
+            "productive_s": round(productive, 6),
+            "wall_s": round(wall, 6),
+            "lost": {k: round(v, 6) for k, v in sorted(lost.items())},
+            "goodput_frac": (round(productive / wall, 6)
+                             if wall > 0 else None),
+        },
+        "straggler": straggler,
+    }
+
+
 def merge_snapshots(sources: Mapping[str, Mapping[str, Any]],
                     versions: Optional[Mapping[str, str]] = None
                     ) -> Dict[str, Any]:
@@ -186,7 +294,7 @@ def merge_snapshots(sources: Mapping[str, Mapping[str, Any]],
             hists_parts.setdefault(hkey, []).append(hsnap)
     histograms = {hkey: merge_histogram_snapshots(parts, key=hkey)
                   for hkey, parts in sorted(hists_parts.items())}
-    return {
+    merged = {
         "meta": {"replica_count": len(sources),
                  "sources": sorted(sources)},
         "replicas": replicas,
@@ -196,6 +304,17 @@ def merge_snapshots(sources: Mapping[str, Mapping[str, Any]],
         "histograms": histograms,
         "histograms_by_replica": hists_by,
     }
+    # goodput-plane blocks (PR 20) federate whenever any source carries
+    # them; sources without one simply don't contribute
+    ts_sources = {rkey: snap["timeseries"] for rkey, snap in sources.items()
+                  if snap.get("timeseries")}
+    if ts_sources:
+        merged["timeseries"] = merge_timeseries_exports(ts_sources)
+    gp_sources = {rkey: snap["goodput"] for rkey, snap in sources.items()
+                  if snap.get("goodput")}
+    if gp_sources:
+        merged["goodput"] = merge_goodput_exports(gp_sources)
+    return merged
 
 
 def federate_host_snapshots(paths: Mapping[str, Any],

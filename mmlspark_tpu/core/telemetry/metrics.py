@@ -108,6 +108,9 @@ DECLARED_METRICS: Dict[str, str] = {
     "dist.membership.stale": "counter",     # rejected stale epochs
     "dist.barrier.timeout": "counter",
     "dist.collective.overrun": "counter",   # hang-budget deadline fired
+    # -- counters: goodput plane (core/telemetry/timeseries.py+goodput.py)
+    "timeseries.samples": "counter",        # one per TimeSeriesStore sweep
+    "training.straggler": "counter",        # + .<host> variants (merge side)
     # -- histograms
     "serving.request.latency": "histogram",
     "serving.batch.fill": "histogram",
@@ -154,6 +157,11 @@ DECLARED_METRICS: Dict[str, str] = {
     "autoscale.target_replicas": "gauge",
     "dist.membership.epoch": "gauge",     # current membership epoch
     "dist.membership.hosts": "gauge",     # live hosts in the view
+    # -- gauges: goodput plane (core/telemetry/goodput.py, PR 20)
+    "training.goodput.frac": "gauge",         # productive / wall, whole run
+    "training.goodput.window_frac": "gauge",  # same over the last K steps
+    "training.goodput.lost_s": "gauge",       # + .<kind> variants
+    "training.straggler.ratio": "gauge",      # p_max/p_median at detection
 }
 
 

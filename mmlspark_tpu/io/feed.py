@@ -275,6 +275,14 @@ class FeedTelemetry:
         with self._lock:
             return dict(self._c)
 
+    def transfer_seconds(self) -> float:
+        """Cumulative host-visible H2D seconds: `device_put` dispatch
+        plus the sharded per-shard puts.  The goodput ledger diffs this
+        around a step's `put_group` to attribute the step's `h2d`
+        segment (docs/observability.md, "The goodput plane")."""
+        with self._lock:
+            return self._c["transfer_s"] + self._c["shard_put_s"]
+
     def delta(self, since: Dict[str, float]) -> Dict[str, float]:
         now = self.snapshot()
         return {k: (now[k] if k in self._MAX_FIELDS

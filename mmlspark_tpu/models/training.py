@@ -7,6 +7,7 @@ handled by XLA from sharding annotations.  bfloat16 compute, float32 state.
 """
 from __future__ import annotations
 
+import itertools
 import math
 import os
 import time
@@ -405,12 +406,21 @@ def make_lm_train_step_3d(model, optimizer, plan, remat: bool = True,
         return jitted
 
     from ..parallel.distributed import run_with_deadline
+    seq = itertools.count()
 
     def guarded_step(params3d, opt_state, tokens):
-        return run_with_deadline(
+        # the guarded path blocks until ready, so its wall IS the step's
+        # compute — record it on the goodput ledger (the resumable loop
+        # records its own steps; it builds the UNguarded factory and
+        # wraps the deadline itself, so nothing double-counts)
+        t0 = time.perf_counter()
+        out = run_with_deadline(
             lambda: jax.block_until_ready(
                 jitted(params3d, opt_state, tokens)),
             hang_budget_s, name="lm_train_step_3d")
+        core_telemetry.LEDGER.record_step(
+            next(seq), compute_s=time.perf_counter() - t0)
+        return out
 
     return guarded_step
 
@@ -586,6 +596,7 @@ def _autosave(mgr, state: TrainState, g: int) -> bool:
     ``checkpoint.write_failed``, keep training.  An InjectedCrash
     (BaseException) still propagates: that simulates process death, not a
     write error."""
+    t0 = time.perf_counter()
     try:
         if g in mgr.all_steps():
             # a rollback replay re-reached a previously saved step: the
@@ -600,6 +611,12 @@ def _autosave(mgr, state: TrainState, g: int) -> bool:
         warnings.warn(f"checkpoint write failed at step {g}: {e!r}",
                       RuntimeWarning, stacklevel=2)
         return False
+    finally:
+        # goodput ledger: checkpoint wall is lost training time (a no-op
+        # for the pre-training floor checkpoint — the ledger only arms
+        # at the first recorded step)
+        core_telemetry.LEDGER.note_lost(
+            "checkpoint", time.perf_counter() - t0)
 
 
 def fit_epochs_resumable(
@@ -681,7 +698,12 @@ def fit_epochs_resumable(
     a failed write warns + counts ``checkpoint.write_failed`` instead of
     killing the run), ``training.resume`` when a run starts from a
     restored step, plus the guard's ``training.anomaly/quarantine/
-    rollback/abort/hang`` ledger."""
+    rollback/abort/hang`` ledger.  Every executed step also lands on the
+    goodput plane (docs/observability.md): a `StepTimeline` record
+    (compute + the feed-measured h2d segment) on
+    ``core_telemetry.LEDGER``, lost-time attribution for checkpoint
+    writes / guard rollbacks / the elastic host-loss ladder, and one
+    cadence-gated ``core_telemetry.STORE.tick()`` sweep."""
     from ..io.feed import DeviceFeed
     from ..parallel.distributed import run_with_deadline
     from ..utils.faults import InjectedFault, fault_point
@@ -755,6 +777,7 @@ def fit_epochs_resumable(
                 # the elastic ladder: ledger the dead peers, roll back to
                 # the checkpoint floor, advance the membership epoch,
                 # rebuild the mesh over the survivors, replay
+                t_loss0 = time.perf_counter()
                 view = elastic.commit_loss(lost)
                 if guard is not None:
                     for h in lost:
@@ -787,6 +810,11 @@ def fit_epochs_resumable(
                     img_sh = batch_sharding(mesh, np.ndim(images))
                     lbl_sh = batch_sharding(mesh, np.ndim(labels))
                 core_telemetry.incr("training.resume")
+                # the whole ladder — quarantine, restore, epoch commit,
+                # mesh rebuild — is the host-loss window the goodput
+                # plane attributes (detection -> resume)
+                core_telemetry.LEDGER.note_lost(
+                    "host_loss", time.perf_counter() - t_loss0)
                 continue
             epoch, b = divmod(g, steps_per_epoch)
             if epoch != order_epoch:
@@ -818,8 +846,10 @@ def fit_epochs_resumable(
                 # a genuinely poisoned batch: NaN data → NaN loss → NaN
                 # grads, end to end through the real jitted step
                 xb = np.full_like(xb, np.nan)
+            h2d0 = feed.telemetry.transfer_seconds()
             dbi, dbl = feed.put_group([xb, yb],
                                       shardings=(img_sh, lbl_sh))
+            h2d_s = feed.telemetry.transfer_seconds() - h2d0
             def _exec(st=state, xi=dbi, yi=dbl):
                 ns, m = step_fn(st, xi, yi)
                 # float() forces the sync, so execution (collectives
@@ -849,6 +879,13 @@ def fit_epochs_resumable(
                 "models.training.step_latency").observe(dt)
             core_telemetry.gauge("models.training.examples_per_sec").set(
                 batch_size / dt if dt > 0 else 0.0)
+            # goodput plane: this step's timeline record (compute + the
+            # h2d segment the feed telemetry measured) and one cadence-
+            # gated timeseries sweep — a few dict writes on the hot
+            # path (< 1% of step time, bench-gated in perf_gate)
+            core_telemetry.LEDGER.record_step(int(g), compute_s=dt,
+                                              h2d=h2d_s)
+            core_telemetry.STORE.tick()
             action = GuardAction.OK
             if guard is not None:
                 loss = metrics.get("loss", float("nan"))
@@ -869,6 +906,7 @@ def fit_epochs_resumable(
             if action == GuardAction.ROLLBACK:
                 # persist the verdict BEFORE restoring: a crash here must
                 # not forget which batch was poisoned
+                t_rb0 = time.perf_counter()
                 guard.save_quarantine(qpath)
                 with core_telemetry.span("training.guard.rollback") as sp:
                     try:
@@ -886,6 +924,8 @@ def fit_epochs_resumable(
                     sp.attrs["lr_scale"] = guard.lr_scale
                 if step_factory is not None:
                     step_fn = step_factory(guard.lr_scale)
+                core_telemetry.LEDGER.note_lost(
+                    "rollback", time.perf_counter() - t_rb0)
                 continue
             state = new_state
             if log_fn:

@@ -243,6 +243,10 @@ def run_with_deadline(fn: Callable, budget_s: Optional[float],
     t.start()
     if not done.wait(timeout=float(budget_s)):
         core_telemetry.incr("dist.collective.overrun")
+        # the spent budget is wall-clock the step can never get back:
+        # attribute it to the goodput ledger's `collective` bucket
+        # (no-op unless training has started)
+        core_telemetry.LEDGER.note_lost("collective", float(budget_s))
         raise CollectiveTimeout(
             f"{name} exceeded its {float(budget_s):g}s hang budget")
     if "error" in box:
@@ -390,8 +394,11 @@ class MembershipView:
 
 def _atomic_write_json(path: str, doc: dict) -> None:
     # tmp + fsync + rename: a crash mid-write leaves the previous file,
-    # never a torn one (the G404-enforced durable-write idiom)
-    tmp = path + ".tmp"
+    # never a torn one (the G404-enforced durable-write idiom).  The tmp
+    # name is per-writer: heartbeats come from both a dedicated beater
+    # thread and loop code, and two writers sharing one tmp path race
+    # each other's os.replace into FileNotFoundError.
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f)
         f.flush()
@@ -507,16 +514,20 @@ class MembershipStore:
                     view = self.load()
                     if view is None:
                         view = self.publish(MembershipView(1, roster))
+                    dt = monotonic() - t0
                     core_telemetry.histogram(
-                        "dist.rendezvous.latency").observe(
-                            monotonic() - t0)
+                        "dist.rendezvous.latency").observe(dt)
+                    # mid-training re-rendezvous is lost wall (the
+                    # ledger drops this before training starts)
+                    core_telemetry.LEDGER.note_lost("rendezvous", dt)
                     return view
             else:
                 view = self.load()
                 if view is not None:
+                    dt = monotonic() - t0
                     core_telemetry.histogram(
-                        "dist.rendezvous.latency").observe(
-                            monotonic() - t0)
+                        "dist.rendezvous.latency").observe(dt)
+                    core_telemetry.LEDGER.note_lost("rendezvous", dt)
                     return view
             sleep(poll_s)
         core_telemetry.incr("dist.rendezvous.failed")

@@ -55,7 +55,8 @@ Fleet telemetry plane (PR 15)
 it pulls every replica's ``/metrics.json`` snapshot (never holding the
 gateway routing lock across the wire), merges them exactly, feeds the
 SLO burn-rate engine, and exposes ``GET /fleet/metrics`` (Prometheus +
-JSON), ``GET /fleet/alerts``, and a federated ``GET /trace/<id>`` that
+JSON), ``GET /fleet/alerts``, ``GET /fleet/goodput`` (the federated
+goodput/straggler view), and a federated ``GET /trace/<id>`` that
 stitches one client trace across gateway + replica span stores.  A pull
 failure marks the replica unhealthy through the same probe/breaker path
 as an active health-probe failure — closing the registry-TTL gap where
@@ -250,6 +251,16 @@ class FleetGateway:
                     merged = outer.telemetry_plane.ensure_fresh()
                     payload = json.dumps(merged, default=repr).encode(
                         "utf-8")
+                    self._reply(200, payload,
+                                {"Content-Type": "application/json"})
+                    return
+                if path == "/fleet/goodput":
+                    # the federated goodput view alone (PR 20): per-host
+                    # summaries, fleet lost-time table, straggler verdict
+                    merged = outer.telemetry_plane.ensure_fresh()
+                    gp = merged.get("goodput") or {
+                        "hosts": {}, "fleet": None, "straggler": None}
+                    payload = json.dumps(gp, default=repr).encode("utf-8")
                     self._reply(200, payload,
                                 {"Content-Type": "application/json"})
                     return

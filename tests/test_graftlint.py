@@ -562,6 +562,65 @@ class TestG3Registries:
         # named family — this is the invariant the fleet merger rides on
         assert g3.bucket_family_findings(ROOT) == []
 
+    @classmethod
+    def _ts_root(cls, tmp_path, metrics_body: str, ts_body: str) -> str:
+        root = cls._metrics_root(tmp_path, metrics_body)
+        (tmp_path / "mmlspark_tpu" / "core" / "telemetry"
+         / "timeseries.py").write_text(ts_body)
+        return root
+
+    def test_m004_unknown_series(self, tmp_path):
+        root = self._ts_root(
+            tmp_path,
+            'DECLARED_METRICS = {"a.count": "counter"}\n',
+            'SAMPLED_SERIES = {"a.count": "counter",\n'
+            '                  "gone.series": "counter"}\n')
+        found = g3.sampled_series_findings(root)
+        assert _rules(found) == ["M004"]
+        assert "gone.series" in found[0].message
+
+    def test_m004_kind_mismatch(self, tmp_path):
+        root = self._ts_root(
+            tmp_path,
+            'DECLARED_METRICS = {"a.count": "counter",\n'
+            '                    "b.level": "gauge"}\n',
+            'SAMPLED_SERIES = {"b.level": "counter"}\n')
+        found = g3.sampled_series_findings(root)
+        assert _rules(found) == ["M004"]
+        assert "declares kind 'counter'" in found[0].message
+        assert "'gauge'" in found[0].message
+
+    def test_m004_family_children_and_clean_table(self, tmp_path):
+        # a child of a declared family samples with the family's kind;
+        # a fully-resolved table produces no findings
+        root = self._ts_root(
+            tmp_path,
+            'DECLARED_METRICS = {"a.count": "counter",\n'
+            '                    "b.level": "gauge"}\n',
+            'SAMPLED_SERIES = {"a.count": "counter",\n'
+            '                  "a.count.child": "counter",\n'
+            '                  "b.level": "gauge"}\n')
+        assert g3.sampled_series_findings(root) == []
+        # ...but a child whose kind contradicts the family is flagged
+        bad = self._ts_root(
+            tmp_path / "bad",
+            'DECLARED_METRICS = {"a.count": "counter"}\n',
+            'SAMPLED_SERIES = {"a.count.child": "gauge"}\n')
+        assert _rules(g3.sampled_series_findings(bad)) == ["M004"]
+
+    def test_m004_skips_trees_without_timeseries(self, tmp_path):
+        # pre-goodput fixture trees have no timeseries module: the rule
+        # must skip, not crash or fabricate findings
+        root = self._metrics_root(
+            tmp_path, 'DECLARED_METRICS = {"a.b": "counter"}\n')
+        assert g3.sampled_series(root) is None
+        assert g3.sampled_series_findings(root) == []
+
+    def test_m004_real_tree_table_is_clean(self):
+        table = g3.sampled_series(ROOT)
+        assert table and "training.goodput.frac" in table
+        assert g3.sampled_series_findings(ROOT) == []
+
     def test_span_naming(self):
         sf = _sf('from ..core.telemetry import span\n'
                  'with span("oneword"):\n    pass\n'
@@ -823,7 +882,7 @@ class TestRepoClean:
 
     def test_rule_catalog_documents_every_reported_rule(self):
         assert {"G101", "G201", "G301", "G401", "G501", "G502",
-                "G503", "G504", "M001", "M002",
+                "G503", "G504", "M001", "M002", "M004",
                 "B001"} <= set(gl_core.RULE_DOCS)
         # G305 is an alias now, not a documented rule of its own
         assert "G305" not in gl_core.RULE_DOCS

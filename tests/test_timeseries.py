@@ -1,0 +1,434 @@
+"""Goodput-plane suite: the ring-buffer time-series engine (cadence
+sampling, counter-reset-aware rate/delta, quantiles, aligned windows,
+exact cross-host merge), the per-step goodput ledger with lost-time
+attribution, and straggler detection over federated timelines.  See
+docs/observability.md "The goodput plane".
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import telemetry
+from mmlspark_tpu.core.telemetry.fleet import (merge_goodput_exports,
+                                               merge_timeseries_exports)
+from mmlspark_tpu.core.telemetry.goodput import (LOST_KINDS, GoodputLedger,
+                                                 detect_straggler)
+from mmlspark_tpu.core.telemetry.metrics import MetricsRegistry
+from mmlspark_tpu.core.telemetry.timeseries import (SAMPLED_SERIES,
+                                                    TimeSeriesStore)
+from mmlspark_tpu.utils.faults import VirtualClock
+
+
+def _store(clock, **kw):
+    """Private store over a private registry: no global-state bleed."""
+    kw.setdefault("registry", MetricsRegistry())
+    return TimeSeriesStore(clock=clock.monotonic, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+
+
+def test_ring_evicts_oldest_at_capacity():
+    vc = VirtualClock()
+    st = _store(vc, capacity=4)
+    for i in range(7):
+        st.record("g", float(i), t=float(i))
+    pts = st.points("g")
+    assert pts == [(3.0, 3.0), (4.0, 4.0), (5.0, 5.0), (6.0, 6.0)]
+    exp = st.export()["series"]["g"]
+    assert exp["evicted"] == 3
+    assert exp["kind"] == "gauge"
+
+
+def test_store_rejects_degenerate_config():
+    vc = VirtualClock()
+    with pytest.raises(ValueError):
+        _store(vc, capacity=1)
+    with pytest.raises(ValueError):
+        _store(vc, cadence_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# PromQL-shaped queries
+
+
+def test_delta_and_rate_survive_counter_reset():
+    vc = VirtualClock()
+    st = _store(vc)
+    # cumulative counter that restarts from zero mid-window (a process
+    # restart): 0 -> 5 -> 8 -> RESET -> 2 -> 4
+    for t, v in [(0, 0), (1, 5), (2, 8), (3, 2), (4, 4)]:
+        st.record("c", float(v), t=float(t), kind="counter")
+    vc.advance(4.0)
+    # increase = 5 + 3 + (post-reset value 2) + 2, never the raw -6
+    assert st.delta("c", window_s=10.0) == pytest.approx(12.0)
+    assert st.rate("c", window_s=10.0) == pytest.approx(12.0 / 4.0)
+
+
+def test_gauge_delta_is_net_change_not_increase():
+    vc = VirtualClock()
+    st = _store(vc)
+    for t, v in [(0, 10.0), (1, 4.0), (2, 7.0)]:
+        st.record("g", v, t=float(t))
+    vc.advance(2.0)
+    assert st.delta("g", window_s=10.0) == pytest.approx(-3.0)
+
+
+def test_windowed_queries_exclude_old_points():
+    vc = VirtualClock()
+    st = _store(vc)
+    for t in range(10):
+        st.record("c", float(t), t=float(t), kind="counter")
+    vc.advance(9.0)
+    # only t >= 5 is inside the window: increase 5 -> 9
+    assert st.delta("c", window_s=4.0) == pytest.approx(4.0)
+    assert st.delta("c", window_s=0.5) is None  # one point is not a delta
+
+
+def test_quantile_over_time_matches_numpy():
+    vc = VirtualClock()
+    st = _store(vc)
+    gen = np.random.default_rng(3)
+    vals = gen.normal(size=41)
+    for i, v in enumerate(vals):
+        st.record("g", float(v), t=float(i))
+    vc.advance(40.0)
+    for q in (0.0, 0.25, 0.5, 0.9, 0.95, 1.0):
+        assert st.quantile_over_time("g", q, window_s=100.0) == \
+            pytest.approx(float(np.quantile(vals, q)))
+    with pytest.raises(ValueError):
+        st.quantile_over_time("g", 1.5, window_s=100.0)
+
+
+def test_aligned_window_snaps_edges_to_grid():
+    vc = VirtualClock()
+    st = _store(vc, cadence_s=1.0)
+    for t in (0.4, 1.4, 2.4, 3.4):
+        st.record("g", t, t=t)
+    vc.advance(3.7)
+    win = st.aligned_window("g", window_s=2.0)
+    # now=3.7 floors to t_end=3.0 on the cadence grid; (1.0, 3.0]
+    assert win["t_end"] == pytest.approx(3.0)
+    assert win["t_start"] == pytest.approx(1.0)
+    assert [t for t, _ in win["points"]] == [pytest.approx(1.4),
+                                             pytest.approx(2.4)]
+    # repeated queries inside one cadence bucket see the SAME edges
+    vc.advance(0.2)
+    again = st.aligned_window("g", window_s=2.0)
+    assert again["t_end"] == win["t_end"]
+
+
+# ---------------------------------------------------------------------------
+# cadence sampling off the registry
+
+
+def test_tick_is_cadence_gated_and_samples_declared_table():
+    vc = VirtualClock()
+    reg = MetricsRegistry()
+    st = TimeSeriesStore(cadence_s=1.0, clock=vc.monotonic, registry=reg)
+    reg.incr("training.autosave")
+    assert st.tick() is True          # first tick always samples
+    assert st.tick() is False         # same instant: gated
+    vc.advance(0.5)
+    assert st.tick() is False         # under cadence: gated
+    vc.advance(0.6)
+    reg.incr("training.autosave", 2)
+    assert st.tick() is True
+    pts = st.points("training.autosave")
+    assert [v for _, v in pts] == [1.0, 3.0]
+    assert st.kind("training.autosave") == "counter"
+    # the sampler meters itself
+    assert reg.counter_values().get("timeseries.samples") == 2
+
+
+def test_sample_derives_histogram_count_and_sum_counters():
+    vc = VirtualClock()
+    reg = MetricsRegistry()
+    st = TimeSeriesStore(cadence_s=1.0, clock=vc.monotonic, registry=reg)
+    h = reg.histogram("models.training.step_latency")
+    h.observe(0.1)
+    h.observe(0.3)
+    st.sample()
+    vc.advance(1.0)
+    h.observe(0.6)
+    st.sample()
+    cnt = st.points("models.training.step_latency.count")
+    tot = st.points("models.training.step_latency.sum")
+    assert [v for _, v in cnt] == [2.0, 3.0]
+    assert [v for _, v in tot] == [pytest.approx(0.4), pytest.approx(1.0)]
+    assert st.kind("models.training.step_latency.count") == "counter"
+    # rate over the derived pair recovers throughput + mean latency
+    vc.advance(0.0)
+    assert st.rate("models.training.step_latency.count", 10.0) \
+        == pytest.approx(1.0)
+
+
+def test_sampled_series_table_is_well_formed():
+    assert SAMPLED_SERIES  # non-empty
+    for name, kind in SAMPLED_SERIES.items():
+        assert kind in ("counter", "gauge", "histogram"), (name, kind)
+
+
+# ---------------------------------------------------------------------------
+# exact cross-host merge
+
+
+def _export_with(points, kind="counter", cadence=1.0):
+    return {"cadence_s": cadence, "capacity": 512,
+            "series": {"s": {"kind": kind, "evicted": 0,
+                             "points": points}}}
+
+
+def test_merge_timeseries_sums_counters_on_common_buckets_only():
+    a = _export_with([[0.2, 1.0], [1.2, 3.0], [2.2, 5.0]])
+    b = _export_with([[0.4, 2.0], [1.4, 4.0]])  # no bucket-2 sample
+    merged = merge_timeseries_exports({"ha": a, "hb": b})
+    ent = merged["series"]["s"]
+    # bucket 2 dropped: hb never contributed, a partial sum would lie
+    assert ent["merged"] == [[0.0, 3.0], [1.0, 7.0]]
+    assert set(ent["by_host"]) == {"ha", "hb"}
+    assert merged["cadence_s"] == 1.0
+
+
+def test_merge_timeseries_keeps_gauges_per_host():
+    a = _export_with([[0.2, 1.0]], kind="gauge")
+    b = _export_with([[0.4, 2.0]], kind="gauge")
+    ent = merge_timeseries_exports({"ha": a, "hb": b})["series"]["s"]
+    assert ent["merged"] is None
+    assert ent["by_host"]["hb"] == [(0.4, 2.0)]
+
+
+def test_merge_timeseries_refuses_kind_and_cadence_drift():
+    a = _export_with([[0.2, 1.0]])
+    with pytest.raises(ValueError, match="kind differs"):
+        merge_timeseries_exports(
+            {"ha": a, "hb": _export_with([[0.4, 2.0]], kind="gauge")})
+    with pytest.raises(ValueError, match="cadence differs"):
+        merge_timeseries_exports(
+            {"ha": a, "hb": _export_with([[0.4, 2.0]], cadence=2.0)})
+
+
+def test_store_roundtrips_through_merge():
+    vc = VirtualClock()
+    reg = MetricsRegistry()
+    st = TimeSeriesStore(cadence_s=1.0, clock=vc.monotonic, registry=reg)
+    reg.incr("dist.host.lost")
+    st.sample()
+    merged = merge_timeseries_exports({"solo": st.export()})
+    assert merged["series"]["dist.host.lost"]["merged"] == [[0.0, 1.0]]
+
+
+# ---------------------------------------------------------------------------
+# the goodput ledger
+
+
+def _ledger(vc, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return GoodputLedger(host_id="t0", clock=vc.monotonic, **kw)
+
+
+def test_ledger_attributes_lost_time_and_computes_goodput():
+    vc = VirtualClock()
+    led = _ledger(vc)
+    vc.advance(1.0)
+    led.record_step(0, compute_s=1.0)       # arms at t_start = 0.0
+    vc.advance(1.0)
+    led.record_step(1, compute_s=0.6, h2d=0.4)
+    led.note_lost("checkpoint", 0.25)
+    vc.advance(0.5)
+    s = led.summary()
+    assert s["steps"] == 2
+    assert s["productive_s"] == pytest.approx(1.6)
+    assert s["lost"] == {"checkpoint": pytest.approx(0.25),
+                         "h2d": pytest.approx(0.4)}
+    assert s["wall_s"] == pytest.approx(2.5)
+    assert s["goodput_frac"] == pytest.approx(1.6 / 2.5)
+    assert s["unattributed_s"] == pytest.approx(2.5 - 1.6 - 0.65)
+
+
+def test_ledger_drops_losses_until_armed():
+    vc = VirtualClock()
+    reg = MetricsRegistry()
+    led = _ledger(vc, registry=reg)
+    # warm-up compile / initial rendezvous: before any step, not lost
+    led.note_lost("recompile", 5.0)
+    vc.advance(1.0)
+    led.record_step(0, compute_s=1.0)
+    led.note_lost("recompile", 0.5)
+    assert led.summary()["lost"] == {"recompile": pytest.approx(0.5)}
+    assert reg.gauge("training.goodput.lost_s").value == pytest.approx(0.5)
+
+
+def test_ledger_rejects_unknown_kinds():
+    vc = VirtualClock()
+    led = _ledger(vc)
+    led.start()
+    with pytest.raises(ValueError):
+        led.note_lost("coffee", 1.0)
+    with pytest.raises(ValueError):
+        led.record_step(0, compute_s=1.0, coffee=1.0)
+    assert "other" in LOST_KINDS
+
+
+def test_attribute_contextmanager_times_the_block():
+    vc = VirtualClock()
+    led = _ledger(vc)
+    led.start()
+    with led.attribute("rollback"):
+        vc.advance(2.5)
+    assert led.summary()["lost"]["rollback"] == pytest.approx(2.5)
+
+
+def test_windowed_goodput_recovers_after_a_loss():
+    vc = VirtualClock()
+    led = _ledger(vc, window_steps=4)
+    for i in range(4):
+        vc.advance(1.0)
+        led.record_step(i, compute_s=1.0, t_start=vc.monotonic() - 1.0)
+    with led.attribute("host_loss"):
+        vc.advance(30.0)                    # the shrink ladder
+    for i in range(4, 10):
+        vc.advance(1.0)
+        led.record_step(i, compute_s=1.0, t_start=vc.monotonic() - 1.0)
+    s = led.summary()
+    # whole-run fraction can never climb back over a 30s hole...
+    assert s["goodput_frac"] < 0.5
+    # ...the recovery signal is the window over the last 4 steps
+    assert s["window"]["goodput_frac"] == pytest.approx(1.0)
+    assert s["lost"]["host_loss"] == pytest.approx(30.0)
+
+
+def test_ledger_export_shape_and_gauges():
+    vc = VirtualClock()
+    reg = MetricsRegistry()
+    led = _ledger(vc, registry=reg)
+    vc.advance(1.0)
+    led.record_step(0, compute_s=0.5)
+    vc.advance(1.0)
+    led.record_step(1, compute_s=0.5)
+    exp = led.export()
+    assert exp["host_id"] == "t0"
+    assert [r["step"] for r in exp["steps"]] == [0, 1]
+    seg = exp["steps"][0]["segments"]
+    assert seg == {"compute": pytest.approx(0.5)}
+    assert 0.0 < reg.gauge("training.goodput.frac").value <= 1.0
+    assert reg.gauge("training.goodput.window_frac").value \
+        == pytest.approx(1.0 / 1.5)
+    led.reset("t1")
+    assert led.summary()["steps"] == 0 and led.host_id == "t1"
+
+
+def test_timeline_ring_bounds_memory():
+    vc = VirtualClock()
+    led = _ledger(vc, capacity=8)
+    led.start()
+    for i in range(20):
+        led.record_step(i, compute_s=0.1, t_start=float(i))
+    recs = led.export()["steps"]
+    assert len(recs) == 8
+    assert [r["step"] for r in recs] == list(range(12, 20))
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+
+
+def _timelines(walls_by_host):
+    return {h: [{"step": i, "wall_s": w} for i, w in enumerate(walls)]
+            for h, walls in walls_by_host.items()}
+
+
+def test_straggler_named_after_streak():
+    tl = _timelines({
+        "h0": [1.0] * 6,
+        "h1": [1.0] * 6,
+        "h2": [1.1] * 6,
+        "h3": [1.0, 3.0, 3.1, 3.2, 3.0, 3.1],  # slow from step 1 on
+    })
+    hit = detect_straggler(tl, ratio=2.0, streak=3)
+    assert hit is not None and hit["host"] == "h3"
+    assert hit["streak"] >= 3 and hit["ratio"] >= 2.0
+
+
+def test_straggler_jitter_is_not_a_streak():
+    gen = np.random.default_rng(11)
+    tl = _timelines({
+        f"h{i}": list(1.0 + 0.2 * gen.uniform(-1, 1, size=12))
+        for i in range(4)
+    })
+    assert detect_straggler(tl, ratio=2.0, streak=3) is None
+    # one isolated 5x step: a spike, not a straggler
+    spiky = _timelines({"h0": [1.0] * 6, "h1": [1.0] * 6,
+                        "h2": [1.0] * 6,
+                        "h3": [1.0, 5.0, 1.0, 1.0, 1.0, 1.0]})
+    assert detect_straggler(spiky, ratio=2.0, streak=3) is None
+
+
+def test_straggler_missing_step_breaks_the_streak():
+    tl = _timelines({"h0": [1.0] * 6, "h1": [1.0] * 6,
+                     "h2": [3.0] * 6})
+    # h2 never reported step 2: skew against a missing host is not
+    # evidence, so the streak restarts — 0,1 then 3,4,5 still names it
+    tl["h2"] = [r for r in tl["h2"] if r["step"] != 2]
+    hit = detect_straggler(tl, ratio=2.0, streak=3)
+    assert hit is not None and hit["host"] == "h2" and hit["step"] == 5
+    # with the gap leaving only 2-step runs, no verdict
+    short = _timelines({"h0": [1.0] * 5, "h1": [1.0] * 5,
+                        "h2": [3.0] * 5})
+    short["h2"] = [r for r in short["h2"] if r["step"] != 2]
+    assert detect_straggler(short, ratio=2.0, streak=3) is None
+
+
+def test_two_hosts_can_never_satisfy_ratio_two():
+    # median of a pair is its mean: max/median < 2 for any positive pair,
+    # so a 2-host pod structurally cannot name a straggler (by design)
+    tl = _timelines({"h0": [1.0] * 8, "h1": [100.0] * 8})
+    assert detect_straggler(tl, ratio=2.0, streak=1) is None
+
+
+# ---------------------------------------------------------------------------
+# federated goodput
+
+
+def _host_export(host, walls, lost=None, productive=None, wall=None):
+    steps = [{"step": i, "t_start": float(i), "wall_s": w,
+              "segments": {"compute": w}} for i, w in enumerate(walls)]
+    productive = sum(walls) if productive is None else productive
+    wall = sum(walls) if wall is None else wall
+    return {"host_id": host,
+            "summary": {"host_id": host, "steps": len(walls),
+                        "wall_s": wall, "productive_s": productive,
+                        "lost": dict(lost or {}),
+                        "goodput_frac": productive / wall if wall else None},
+            "steps": steps}
+
+
+def test_merge_goodput_rolls_up_fleet_and_sums_lost():
+    a = _host_export("h0", [1.0] * 4, lost={"checkpoint": 0.5}, wall=5.0)
+    b = _host_export("h1", [1.0] * 4, lost={"checkpoint": 0.25,
+                                            "host_loss": 2.0}, wall=7.0)
+    merged = merge_goodput_exports({"h0": a, "h1": b})
+    assert set(merged["hosts"]) == {"h0", "h1"}
+    fleet = merged["fleet"]
+    assert fleet["productive_s"] == pytest.approx(8.0)
+    assert fleet["wall_s"] == pytest.approx(12.0)
+    assert fleet["lost"] == {"checkpoint": pytest.approx(0.75),
+                             "host_loss": pytest.approx(2.0)}
+    assert fleet["goodput_frac"] == pytest.approx(8.0 / 12.0)
+    assert merged["straggler"] is None
+
+
+def test_merge_goodput_surfaces_straggler_on_registry():
+    before = telemetry.counters("training.straggler")
+    exports = {h: _host_export(h, [1.0] * 6) for h in ("h0", "h1", "h2")}
+    exports["h3"] = _host_export("h3", [3.0] * 6)
+    merged = merge_goodput_exports(exports)
+    assert merged["straggler"] is not None
+    assert merged["straggler"]["host"] == "h3"
+    after = telemetry.counters("training.straggler")
+    assert after.get("training.straggler", 0) \
+        == before.get("training.straggler", 0) + 1
+    assert after.get("training.straggler.h3", 0) \
+        == before.get("training.straggler.h3", 0) + 1
+    assert telemetry.gauge("training.straggler.ratio").value \
+        == pytest.approx(3.0)

@@ -703,11 +703,11 @@ def write_obs_snapshot(path) -> str:
     tools/obs_report.py renders.  The meta timestamp makes the saved
     file self-describing (which soak, which process, which backend).
 
-    Declared `training.*` / `checkpoint.*` counters are zero-filled when
-    untouched so every soak (this one, tools/train_soak.py,
-    tools/fleet_soak.py) emits one uniform counter shape — an assertion
-    on `counters["training.rollback"]` never KeyErrors into a false
-    pass."""
+    Declared `training.*` / `checkpoint.*` / `timeseries.*` counters are
+    zero-filled when untouched so every soak (this one,
+    tools/train_soak.py, tools/fleet_soak.py) emits one uniform counter
+    shape — an assertion on `counters["training.rollback"]` never
+    KeyErrors into a false pass."""
     import time
 
     from mmlspark_tpu.core import telemetry
@@ -718,7 +718,8 @@ def write_obs_snapshot(path) -> str:
         timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     for name, kind in DECLARED_METRICS.items():
         if kind == "counter" and name.startswith(("training.",
-                                                  "checkpoint.")):
+                                                  "checkpoint.",
+                                                  "timeseries.")):
             snap["counters"].setdefault(name, 0)
     p.write_text(json.dumps(snap, indent=2, sort_keys=True))
     return str(p)

@@ -35,6 +35,11 @@ Two legs, together covering the whole elastic ladder
   `merge_snapshots` — asserting the pod-level view converges: exactly
   one `dist.host.lost` across the fleet, both survivors on membership
   epoch 2, exactly-once ledgers, quarantine.json on every survivor.
+  The federated goodput plane rides the same scrape: the kill's loss
+  window must show up in the fleet lost-time table under `host_loss`,
+  each survivor's post-resume windowed goodput must recover to within
+  10 points of its pre-kill window, and the straggler detector must
+  name no host on the healthy post-shrink pod.
 
 Runs entirely on CPU (tools/ci.py `dist-soak`).  Exit 0 ⇒ every
 invariant held.
@@ -267,6 +272,9 @@ def run_worker(args) -> int:
     root = Path(args.root)
     host_id, rank = args.id, args.rank
     coordinator = rank == 0
+    # the goodput ledger keys the federated plane by host id; a fresh
+    # worker process would otherwise report as "pid<N>"
+    telemetry.LEDGER.reset(host_id)
     store = dist.MembershipStore(root / "plane")
     info = dist.HostInfo(host_id, rank, jax.local_device_count())
     view = store.rendezvous(info, expected=args.nproc,
@@ -316,7 +324,8 @@ def run_worker(args) -> int:
         raise RuntimeError(f"{host_id}: hold timed out — no peer death "
                            f"observed within 90s")
 
-    held = {"done": False}
+    held = {"done": False, "pre_window": None}
+
     positions = []
 
     def log_fn(step, metrics):
@@ -325,13 +334,36 @@ def run_worker(args) -> int:
                     {"host_id": host_id, "step": step})
         if step == HOLD_STEP and not held["done"]:
             held["done"] = True
+            # pre-kill windowed goodput: the recovery baseline the parent
+            # compares the post-resume window against
+            held["pre_window"] = \
+                telemetry.LEDGER.summary()["window"]["goodput_frac"]
             hold()
 
     _, mesh, imgs, lbls, make_step, fresh = _setup(POD_ROWS, POD_BATCH)
     guard = TrainingGuard(watchdog=False)
     ckpt = root / "ckpt" / host_id
+    step_fn = make_step(mesh)
+    # compile outside the ledgered loop (the _measure_guard idiom) so the
+    # pre-kill goodput window measures steady steps, not one compile —
+    # warmed through the SAME feed/sharding path the loop uses, or the
+    # sharded first batch would recompile inside the window anyway
+    from mmlspark_tpu.io.feed import DeviceFeed
+    from mmlspark_tpu.parallel.mesh import batch_sharding
+    warm_feed = DeviceFeed(mesh=mesh)
+    dbi, dbl = warm_feed.put_group(
+        [imgs[:POD_BATCH], lbls[:POD_BATCH]],
+        shardings=(batch_sharding(mesh, imgs.ndim),
+                   batch_sharding(mesh, lbls.ndim)))
+    # two calls, output state fed back: the step specializes separately
+    # on the fresh state's layout and its own output layout
+    wstate = fresh()
+    for _ in range(2):
+        wstate, wmetrics = step_fn(wstate, dbi, dbl)
+    jax.block_until_ready(wmetrics["loss"])
+    del wstate, wmetrics
     state, metrics = fit_epochs_resumable(
-        make_step(mesh), fresh(), imgs, lbls, batch_size=POD_BATCH,
+        step_fn, fresh(), imgs, lbls, batch_size=POD_BATCH,
         checkpoint_dir=str(ckpt), epochs=EPOCHS,
         checkpoint_every=CKPT_EVERY, mesh=mesh, seed=args.seed,
         log_fn=log_fn, guard=guard, elastic=ctx)
@@ -349,6 +381,8 @@ def run_worker(args) -> int:
         "epoch": ctx.view.epoch,
         "positions": positions,
         "counters": dict(telemetry.counters("dist.")),
+        "goodput_pre_kill_window": held["pre_window"],
+        "goodput": telemetry.LEDGER.summary(),
     })
     # keep the telemetry endpoint alive until the parent has scraped it
     deadline = time.monotonic() + 60.0
@@ -483,6 +517,35 @@ def run_pod(workdir, seed: int = 7) -> dict:
         "rendezvous attempts missing from the federated view")
     assert mc.get("dist.membership.update", 0) >= 2, (
         "epoch-1 + epoch-2 publishes missing from the federated view")
+
+    # -- federated goodput plane (docs/observability.md) --------------
+    # the survivors' live /metrics.json snapshots each carry a goodput
+    # block; merge_snapshots federates them via merge_goodput_exports
+    gp = merged.get("goodput")
+    assert gp, "federated snapshot carries no goodput block"
+    fleet_lost = gp["fleet"]["lost"]
+    assert fleet_lost.get("host_loss", 0) > 0, (
+        f"the kill's loss window was not attributed to host_loss: "
+        f"fleet lost-time table {fleet_lost}")
+    # 2 surviving hosts cannot satisfy the p_max/p_median >= 2.0 streak
+    # (median of a pair is the mean), so a healthy post-shrink pod must
+    # name NO straggler — any hit here is a false positive
+    assert gp["straggler"] is None, (
+        f"straggler named on a healthy 2-host pod: {gp['straggler']}")
+    post_windows = {}
+    for h in survivors:
+        pre = reports[h]["goodput_pre_kill_window"]
+        post = snaps[h]["goodput"]["summary"]["window"]["goodput_frac"]
+        assert pre is not None and post is not None, (
+            f"{h}: goodput windows missing (pre={pre}, post={post})")
+        # recovery contract: post-resume windowed goodput is within 10
+        # absolute points of the pre-kill window (both are steady-step
+        # windows; the hold/rollback wall lands in host_loss, not here)
+        assert post >= pre - 0.10, (
+            f"{h} goodput did not recover: post-resume window "
+            f"{post:.3f} < pre-kill window {pre:.3f} - 0.10")
+        post_windows[h] = post
+
     return {
         "nproc": POD_NPROC,
         "killed": victim_id,
@@ -490,8 +553,14 @@ def run_pod(workdir, seed: int = 7) -> dict:
                           "final_loss": reports[h]["final_loss"],
                           "epoch": reports[h]["epoch"],
                           "replayed_steps":
-                              len(reports[h]["positions"]) - POD_TOTAL}
+                              len(reports[h]["positions"]) - POD_TOTAL,
+                          "goodput_pre_kill_window":
+                              reports[h]["goodput_pre_kill_window"],
+                          "goodput_post_window": post_windows[h]}
                       for h in survivors},
+        "fleet_goodput_frac": gp["fleet"]["goodput_frac"],
+        "fleet_lost_time": fleet_lost,
+        "straggler": gp["straggler"],
         "fleet_counters": {k: mc[k] for k in sorted(mc)
                            if k.startswith("dist.")},
     }
@@ -544,7 +613,9 @@ def main(argv=None):
               f"{pod['nproc']}, survivors finished "
               f"{POD_TOTAL} steps on epoch 2, fleet saw "
               f"{pod['fleet_counters'].get('dist.host.lost')} host "
-              f"loss in {summary['wall_s']}s")
+              f"loss ({pod['fleet_lost_time'].get('host_loss', 0):.2f}s "
+              f"attributed to host_loss, goodput recovered, no "
+              f"straggler) in {summary['wall_s']}s")
     if sanitizing and not args.json:
         print(san_text)
     return rc

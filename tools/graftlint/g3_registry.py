@@ -23,6 +23,11 @@ violate at runtime:
   which is exact only when every process shares identical ``le``
   edges — a histogram outside a family is one bucket-ladder drift away
   from a silently-wrong merged p99.
+* **M004 — timeseries sampled series ↔ DECLARED_METRICS.**  Every
+  ``SAMPLED_SERIES`` entry (core/telemetry/timeseries.py) must
+  reference a declared metric with a matching kind: the sampler reads
+  the registry by NAME every cadence tick, so a renamed or re-kinded
+  metric would leave a stale entry silently sampling zeros forever.
 * **G303 — span naming.**  ``span()``/``record_span()`` literals must
   follow the ``layer.component[.detail]`` lowercase dotted convention
   (docs/observability.md); a one-word span name is unfindable next to
@@ -61,6 +66,7 @@ __all__ = ["check_registries", "declared_metric_names",
            "declared_metric_kinds", "histogram_family_tables",
            "sanitize_metric_name", "metric_findings",
            "collision_findings", "bucket_family_findings",
+           "sampled_series", "sampled_series_findings",
            "fault_point_sites", "documented_fault_points",
            "declared_mesh_axes"]
 
@@ -157,11 +163,9 @@ _TELEMETRY_IMPORT = re.compile(
 _TELEMETRY_PKG = "mmlspark_tpu/core/telemetry"
 
 
-def _metrics_dict_literal(root: str, var: str) -> Optional[ast.Dict]:
-    """The ``var = {...}`` dict literal in metrics.py, via AST —
+def _dict_literal_at(path: str, var: str) -> Optional[ast.Dict]:
+    """The ``var = {...}`` dict literal in one source file, via AST —
     importing mmlspark_tpu here would pull jax into every lint."""
-    path = os.path.join(root, "mmlspark_tpu", "core", "telemetry",
-                        "metrics.py")
     with open(path, encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=path)
     for node in ast.walk(tree):
@@ -175,6 +179,25 @@ def _metrics_dict_literal(root: str, var: str) -> Optional[ast.Dict]:
                 and isinstance(node.value, ast.Dict)):
             return node.value
     return None
+
+
+def _str_dict(lit: Optional[ast.Dict]) -> Dict[str, str]:
+    """str->str entries of a parsed dict literal (others skipped)."""
+    out: Dict[str, str] = {}
+    if lit is not None:
+        for k, v in zip(lit.keys, lit.values):
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                out[k.value] = v.value
+    return out
+
+
+def _metrics_dict_literal(root: str, var: str) -> Optional[ast.Dict]:
+    """The ``var = {...}`` dict literal in metrics.py."""
+    return _dict_literal_at(
+        os.path.join(root, "mmlspark_tpu", "core", "telemetry",
+                     "metrics.py"), var)
 
 
 def declared_metric_names(root: str) -> Set[str]:
@@ -292,6 +315,57 @@ def bucket_family_findings(root: str) -> List[Finding]:
             message=f"HISTOGRAM_FAMILY entry {name!r} is not a declared "
                     f"histogram in DECLARED_METRICS",
             hint="prune the stale mapping (or declare the histogram)"))
+    return findings
+
+
+def sampled_series(root: str) -> Optional[Dict[str, str]]:
+    """The timeseries sampler's ``SAMPLED_SERIES`` table (name -> kind)
+    parsed out of timeseries.py's dict literal; None when the tree has
+    no timeseries module (pre-goodput fixtures)."""
+    path = os.path.join(root, "mmlspark_tpu", "core", "telemetry",
+                        "timeseries.py")
+    if not os.path.exists(path):
+        return None
+    return _str_dict(_dict_literal_at(path, "SAMPLED_SERIES"))
+
+
+def sampled_series_findings(root: str) -> List[Finding]:
+    """M004: every SAMPLED_SERIES entry must reference a declared
+    metric with a matching kind.  The sampler reads the registry by
+    NAME every cadence tick — a renamed or re-kinded metric leaves a
+    stale entry silently sampling zeros forever, which is exactly the
+    drift M001 catches on the write side."""
+    table = sampled_series(root)
+    if table is None:
+        return []
+    kinds = declared_metric_kinds(root)
+    ts_rel = f"{_TELEMETRY_PKG}/timeseries.py"
+    findings: List[Finding] = []
+    for name, kind in table.items():
+        decl_kind = kinds.get(name)
+        if decl_kind is None:
+            # a child of a declared family samples with the family's kind
+            parent = next((d for d in kinds if name.startswith(d + ".")),
+                          None)
+            if parent is None:
+                findings.append(Finding(
+                    rule="M004", path=ts_rel, line=0, symbol=name,
+                    message=f"sampled series {name!r} not in "
+                            f"DECLARED_METRICS "
+                            f"({_TELEMETRY_PKG}/metrics.py)",
+                    hint="declare the metric or prune the stale entry "
+                         "— the sampler would record zeros forever"))
+                continue
+            decl_kind = kinds[parent]
+        if kind != decl_kind:
+            findings.append(Finding(
+                rule="M004", path=ts_rel, line=0, symbol=name,
+                message=f"sampled series {name!r} declares kind "
+                        f"{kind!r} but DECLARED_METRICS says "
+                        f"{decl_kind!r}",
+                hint="the sampler reads counters/gauges/histograms "
+                     "through different registry surfaces — the kinds "
+                     "must agree"))
     return findings
 
 
@@ -493,6 +567,7 @@ def check_registries(files: Sequence[SourceFile], root: str
     findings = _fault_registry_findings(files, root)
     findings += collision_findings(declared)
     findings += bucket_family_findings(root)
+    findings += sampled_series_findings(root)
     findings += metric_findings(files, declared)
     findings += _span_findings(files)
     findings += _queue_telemetry_findings(files)

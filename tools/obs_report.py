@@ -17,10 +17,16 @@ Usage:
     python tools/obs_report.py --incident incidents/<ts>-<reason>/
                                         # pretty-print a flight-recorder
                                         # bundle (docs/observability.md)
+    python tools/obs_report.py SNAPSHOT.json --goodput
+    python tools/obs_report.py --fleet http://HOST:PORT --goodput
+                                        # goodput plane only: lost-time
+                                        # attribution + straggler verdict
+                                        # + per-host step waterfall
 
 Also importable (tests/test_observability.py, tests/test_fleet_obs.py):
 `render_report(snapshot)` / `render_fleet_report(merged)` /
-`render_incident(bundle_dir)` return the full text.
+`render_goodput_report(block)` / `render_incident(bundle_dir)` return
+the full text.
 """
 from __future__ import annotations
 
@@ -162,6 +168,114 @@ def render_fleet_report(merged: Dict[str, Any],
         tree = stitched.get("tree") or []
         lines.append(format_span_tree(tree) if tree else "  (no spans)")
         lines.append("")
+    if merged.get("goodput"):
+        lines.append(render_goodput_report(merged["goodput"]))
+    return "\n".join(lines)
+
+
+#: one glyph per timeline segment in the waterfall bars
+_SEGMENT_GLYPHS = {
+    "compute": "#", "h2d": "h", "collective": "x", "checkpoint": "c",
+    "rollback": "r", "recompile": "j", "rendezvous": "z",
+    "host_loss": "L", "quarantine": "q", "other": "o",
+}
+
+
+def _norm_goodput(block: Dict[str, Any]):
+    """Accept either one host's `GoodputLedger.export()` dict or the
+    federated `merge_goodput_exports` shape; return
+    ({host: (summary, steps)}, fleet_rollup_or_None, straggler)."""
+    if "hosts" in block:
+        hosts = {h: (dict(e.get("summary") or {}), list(e.get("steps") or []))
+                 for h, e in (block.get("hosts") or {}).items()}
+        return hosts, block.get("fleet"), block.get("straggler")
+    host = str(block.get("host_id", "?"))
+    return ({host: (dict(block.get("summary") or {}),
+                    list(block.get("steps") or []))}, None, None)
+
+
+def render_goodput_report(block: Dict[str, Any], width: int = 40,
+                          max_steps: int = 12) -> str:
+    """The goodput plane for humans: per-host goodput fractions, the
+    lost-time attribution table, the straggler verdict, and a per-host
+    step waterfall (one bar per recent step, wall-scaled, segment
+    glyphs per `_SEGMENT_GLYPHS`).  Input: the `goodput` block of an
+    `export_snapshot()` (one host) or of a merged fleet view / the
+    gateway's ``GET /fleet/goodput`` payload."""
+    hosts, fleet, straggler = _norm_goodput(block)
+    lines: List[str] = ["== goodput =="]
+    if fleet:
+        frac = fleet.get("goodput_frac")
+        lines.append(
+            f"  fleet: goodput_frac="
+            f"{'-' if frac is None else format(frac, '.3f')} "
+            f"(productive {fleet.get('productive_s', 0)}s / wall "
+            f"{fleet.get('wall_s', 0)}s)")
+    for host in sorted(hosts):
+        summ, _steps = hosts[host]
+        frac = summ.get("goodput_frac")
+        wfrac = (summ.get("window") or {}).get("goodput_frac")
+        lines.append(
+            f"  {host}: steps={summ.get('steps', 0)} goodput_frac="
+            f"{'-' if frac is None else format(frac, '.3f')} "
+            f"window_frac="
+            f"{'-' if wfrac is None else format(wfrac, '.3f')}")
+    lines.append("")
+    lost_rows: Dict[str, Dict[str, float]] = {}
+    for host in sorted(hosts):
+        for kind, v in (hosts[host][0].get("lost") or {}).items():
+            lost_rows.setdefault(kind, {})[host] = float(v)
+        un = float(hosts[host][0].get("unattributed_s") or 0.0)
+        if un > 0:
+            lost_rows.setdefault("(unattributed)", {})[host] = un
+    lines.append("== lost-time attribution (seconds) ==")
+    if lost_rows:
+        for kind in sorted(lost_rows):
+            total = sum(lost_rows[kind].values())
+            split = ", ".join(f"{h}={lost_rows[kind][h]:.3f}"
+                              for h in sorted(lost_rows[kind]))
+            lines.append(f"  {kind:<16} {total:>9.3f}  [{split}]")
+    else:
+        lines.append("  (nothing lost — or nothing attributed yet)")
+    lines.append("")
+    if straggler:
+        lines.append(f"== straggler: {straggler.get('host')} "
+                     f"(p_max/p_median {straggler.get('ratio')} over "
+                     f"{straggler.get('streak')} consecutive steps, last "
+                     f"at step {straggler.get('step')}) ==")
+    else:
+        lines.append("== straggler: none detected ==")
+    lines.append("")
+    all_steps = [s for _summ, steps in hosts.values() for s in steps]
+    max_wall = max((float(s.get("wall_s") or 0.0) for s in all_steps),
+                   default=0.0)
+    for host in sorted(hosts):
+        _summ, steps = hosts[host]
+        if not steps:
+            continue
+        lines.append(f"== step waterfall: {host} "
+                     f"(last {min(len(steps), max_steps)} of "
+                     f"{len(steps)} recorded) ==")
+        for rec in steps[-max_steps:]:
+            wall = float(rec.get("wall_s") or 0.0)
+            cols = (int(round(width * wall / max_wall))
+                    if max_wall > 0 else 0)
+            bar = ""
+            segs = rec.get("segments") or {}
+            for kind in _SEGMENT_GLYPHS:
+                v = float(segs.get(kind) or 0.0)
+                if v > 0 and wall > 0:
+                    n = max(1, int(round(cols * v / wall)))
+                    bar += _SEGMENT_GLYPHS[kind] * n
+            bar = bar[:width].ljust(width, " ")
+            parts = ", ".join(f"{k} {float(v):.3f}"
+                              for k, v in sorted(segs.items()))
+            lines.append(f"  step {int(rec.get('step', 0)):>5} |{bar}| "
+                         f"{wall:.3f}s  ({parts})")
+        lines.append("")
+    legend = "  ".join(f"{g}={k}" for k, g in _SEGMENT_GLYPHS.items())
+    lines.append(f"  legend: {legend}")
+    lines.append("")
     return "\n".join(lines)
 
 
@@ -292,8 +406,16 @@ def main(argv=None) -> int:
     ap.add_argument("--incident", default=None, metavar="DIR",
                     help="pretty-print one flight-recorder bundle "
                          "(incidents/<ts>-<reason>/)")
+    ap.add_argument("--goodput", action="store_true",
+                    help="render only the goodput plane: lost-time "
+                         "attribution table, straggler verdict, and the "
+                         "per-host step waterfall")
     args = ap.parse_args(argv)
     if args.fleet:
+        if args.goodput:
+            gp = _fetch_json(args.fleet.rstrip("/") + "/fleet/goodput")
+            print(render_goodput_report(gp or {}))
+            return 0
         print(_fleet_report(args.fleet, args.trace))
         return 0
     if args.incident:
@@ -305,6 +427,16 @@ def main(argv=None) -> int:
         snapshot = json.loads(Path(args.snapshot).read_text())
     else:
         ap.error("need a SNAPSHOT.json or --demo")
+    if args.goodput:
+        # accept a full snapshot/merged view (goodput block inside) or a
+        # bare goodput payload saved from GET /fleet/goodput
+        block = snapshot.get("goodput") or snapshot
+        if not ("hosts" in block or "summary" in block):
+            print("no goodput block in this snapshot (nothing recorded "
+                  "a training step)")
+            return 1
+        print(render_goodput_report(block))
+        return 0
     if args.chrome_out:
         from mmlspark_tpu.core.telemetry import render_chrome_trace
 

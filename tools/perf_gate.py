@@ -21,6 +21,8 @@ the gate must stay green across PRs that add new bench fields.
 from __future__ import annotations
 
 import argparse
+import ast
+import datetime
 import json
 import os
 import sys
@@ -28,6 +30,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(ROOT, "BENCH_LASTGOOD.json")
+
+#: a baseline older than this is chip-number archaeology, not a gate
+MAX_BASELINE_AGE_DAYS = 14.0
 
 # metric -> (direction, relative tolerance, absolute floor).
 # direction "higher": regression when fresh < base * (1 - rel) - abs;
@@ -78,6 +83,10 @@ GATE_METRICS: Dict[str, Tuple[str, float, float]] = {
     # Host-side HTTP timings swing with machine load (50%), with an
     # absolute floor so a near-zero base doesn't trip on scheduler dust
     "fleet_scrape_ms": ("lower", 0.50, 5.0),
+    # the goodput plane's per-step hot path (LEDGER.record_step +
+    # STORE.tick, PR 20): <1% of step time, absolute band like the
+    # guard/sanitizer plumbing contracts above
+    "timeseries_overhead_frac": ("lower", 0.0, 0.01),
 }
 
 
@@ -91,6 +100,66 @@ def load_record(path: str) -> Dict[str, Any]:
     if not isinstance(doc, dict):
         raise ValueError(f"{path}: expected a JSON object bench record")
     return doc
+
+
+def bench_schema(root: str = ROOT) -> Optional[int]:
+    """The current ``BENCH_SCHEMA`` constant, AST-parsed out of
+    bench.py (importing bench would pull jax into the gate)."""
+    path = os.path.join(root, "bench.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "BENCH_SCHEMA"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            return node.value.value
+    return None
+
+
+def baseline_age_days(base: Dict[str, Any],
+                      now: Optional[datetime.datetime] = None
+                      ) -> Optional[float]:
+    """Age of the baseline's ``measured_at`` stamp in days; None when
+    the stamp is missing or unparseable."""
+    ts = base.get("measured_at")
+    if not isinstance(ts, str):
+        return None
+    try:
+        measured = datetime.datetime.strptime(
+            ts, "%Y-%m-%dT%H:%M:%SZ").replace(tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return None
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    return (now - measured).total_seconds() / 86400.0
+
+
+def stale_baseline_warnings(base: Dict[str, Any],
+                            now: Optional[datetime.datetime] = None,
+                            root: str = ROOT) -> List[str]:
+    """Reasons the baseline is stale chip numbers: it predates the
+    current bench schema (missing or older ``schema`` stamp) or its
+    ``measured_at`` is over `MAX_BASELINE_AGE_DAYS` old / missing."""
+    msgs: List[str] = []
+    current = bench_schema(root)
+    recorded = base.get("schema")
+    if current is not None and recorded != current:
+        msgs.append(
+            f"baseline schema {recorded!r} predates current bench "
+            f"schema {current} — fields added since were never "
+            f"measured on this baseline")
+    age = baseline_age_days(base, now=now)
+    if age is None:
+        msgs.append("baseline has no parseable measured_at stamp — "
+                    "age unknown, chip numbers unverifiable")
+    elif age > MAX_BASELINE_AGE_DAYS:
+        msgs.append(f"baseline is {age:.1f} days old "
+                    f"(limit {MAX_BASELINE_AGE_DAYS:g})")
+    return msgs
 
 
 def _numeric(v: Any) -> Optional[float]:
@@ -167,6 +236,18 @@ def main(argv=None) -> int:
 
     fresh = load_record(args.fresh)
     base = load_record(args.against)
+
+    # stale chip numbers make the whole comparison archaeology — still
+    # gate (bands may catch gross breakage) but say so LOUDLY instead
+    # of silently comparing against a dead machine's numbers
+    stale_msgs = stale_baseline_warnings(base)
+    for msg in stale_msgs:
+        banner = "!" * 72
+        print(banner)
+        print(f"perf-gate: STALE BASELINE — {msg}")
+        print(f"perf-gate: refresh with `python bench.py --json > "
+              f"{os.path.basename(args.against)}` on a quiet machine")
+        print(banner)
 
     # a stale record means bench fell back to the last-good numbers (an
     # infra failure, not a measurement) — diffing it against itself
