@@ -1,0 +1,414 @@
+"""Goodput plane (core/telemetry/goodput.py, PR 16): taxonomy
+completeness, exactly-once attribution under a VirtualClock, bounded
+memory, registry emission, fleet merge, and the export_snapshot ride.
+
+Everything host-side and jax-free except the two integration tests at
+the bottom — the ledger itself must import and run without jax (the
+telemetry package promise)."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from mmlspark_tpu.core.telemetry import metrics as metrics_mod
+from mmlspark_tpu.core.telemetry.goodput import (BADPUT_PHASES, GOODPUT,
+                                                 GoodputLedger, PHASES,
+                                                 merge_goodput_snapshots)
+from mmlspark_tpu.core.telemetry.metrics import REGISTRY
+from mmlspark_tpu.utils.faults import VirtualClock
+
+
+def _ledger(clock, **kw):
+    kw.setdefault("emit", False)
+    return GoodputLedger(clock=clock.monotonic, **kw)
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+class TestTaxonomy:
+    def test_taxonomy_is_fixed_and_exhaustive(self):
+        assert PHASES == ("compute", "data_wait", "h2d", "sync",
+                          "checkpoint", "recompile", "guard", "idle")
+        assert BADPUT_PHASES == tuple(p for p in PHASES if p != "compute")
+
+    def test_every_phase_attributable_and_snapshot_dense(self):
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            for p in PHASES:
+                led.attribute(p, 0.125)
+            vc.advance(0.125 * len(PHASES))
+        snap = led.snapshot()
+        # dense: every taxonomy phase present even when zero elsewhere
+        assert tuple(snap["phases"]) == PHASES
+        assert all(snap["phases"][p] == pytest.approx(0.125)
+                   for p in PHASES)
+
+    def test_unknown_phase_rejected_everywhere(self):
+        led = _ledger(VirtualClock())
+        with pytest.raises(ValueError):
+            led.attribute("swapping", 1.0)
+        with pytest.raises(ValueError):
+            with led.phase("swapping"):
+                pass
+        with pytest.raises(ValueError):
+            led.reclassify("compute", "swapping", 1.0)
+
+
+# ---------------------------------------------------------------------------
+# attribution under a VirtualClock: exact magnitudes, exactly-once
+# ---------------------------------------------------------------------------
+
+class TestAttribution:
+    def test_phases_tile_wall_with_idle_residual(self):
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            led.step_begin(0)
+            with led.phase("data_wait"):
+                vc.advance(0.25)
+            with led.phase("compute"):
+                vc.advance(1.0)
+            vc.advance(0.05)  # unattributed loop overhead
+            led.step_end()
+        snap = led.snapshot()
+        assert snap["wall_s"] == pytest.approx(1.30)
+        assert snap["phases"]["data_wait"] == pytest.approx(0.25)
+        assert snap["phases"]["compute"] == pytest.approx(1.0)
+        assert snap["phases"]["idle"] == pytest.approx(0.05)
+        assert sum(snap["phases"].values()) == pytest.approx(snap["wall_s"])
+        assert snap["coverage"] == pytest.approx(1.0)
+        assert snap["goodput_frac"] == pytest.approx(1.0 / 1.30)
+        assert led.reconcile()["ok"]
+
+    def test_nested_phase_excludes_exactly_once(self):
+        """A checkpoint restore inside a guard rollback: checkpoint gets
+        its wall, guard only the ladder overhead around it."""
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            led.step_begin(0)
+            with led.phase("guard"):
+                vc.advance(0.2)
+                with led.phase("checkpoint"):
+                    vc.advance(0.3)
+                vc.advance(0.3)
+            led.step_end()
+        snap = led.snapshot()
+        assert snap["phases"]["guard"] == pytest.approx(0.5)
+        assert snap["phases"]["checkpoint"] == pytest.approx(0.3)
+        assert snap["phases"]["idle"] == pytest.approx(0.0)
+        assert sum(snap["phases"].values()) == pytest.approx(0.8)
+
+    def test_attribute_inside_phase_excludes(self):
+        """The compile sentry attributes recompile seconds from INSIDE
+        the loop's compute block — compute must shrink by that amount,
+        not double-count it."""
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            led.step_begin(0)
+            with led.phase("compute"):
+                vc.advance(0.75)
+                led.attribute("recompile", 0.25)
+                vc.advance(0.25)
+            led.step_end()
+        snap = led.snapshot()
+        assert snap["phases"]["compute"] == pytest.approx(0.75)
+        assert snap["phases"]["recompile"] == pytest.approx(0.25)
+        assert sum(snap["phases"].values()) == pytest.approx(1.0)
+
+    def test_reclassify_moves_pending(self):
+        """The hang split: a step's compute beyond the hang budget is
+        guard badput."""
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            led.step_begin(0)
+            with led.phase("compute"):
+                vc.advance(5.0)
+            moved = led.reclassify("compute", "guard", 4.5)
+            assert moved == pytest.approx(4.5)
+            # can't move more than is pending
+            assert led.reclassify("compute", "guard", 10.0) == \
+                pytest.approx(0.5)
+            led.step_end()
+        snap = led.snapshot()
+        assert snap["phases"]["compute"] == pytest.approx(0.0)
+        assert snap["phases"]["guard"] == pytest.approx(5.0)
+
+    def test_disarmed_ledger_is_a_noop(self):
+        vc = VirtualClock()
+        led = _ledger(vc)
+        led.attribute("h2d", 1.0)  # no session open
+        with led.phase("checkpoint"):
+            vc.advance(1.0)
+        snap = led.snapshot()
+        assert snap["wall_s"] == 0.0
+        assert all(v == 0.0 for v in snap["phases"].values())
+        assert snap["steps"] == 0
+
+    def test_session_is_reentrant(self):
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            with led.session():  # nested fit shares the outer session
+                led.attribute("compute", 1.0)
+                vc.advance(1.0)
+            assert led.active  # inner exit must not disarm
+            led.attribute("compute", 0.5)
+            vc.advance(0.5)
+        assert not led.active
+        snap = led.snapshot()
+        assert snap["phases"]["compute"] == pytest.approx(1.5)
+        assert snap["wall_s"] == pytest.approx(1.5)
+
+    def test_cross_step_attributions_land_in_next_entry(self):
+        """Interstep feed work (the stream generator's data_wait/h2d)
+        accrues to the entry the following step_end closes — nothing is
+        lost between steps."""
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            led.step_begin(0)
+            with led.phase("compute"):
+                vc.advance(1.0)
+            led.step_end()
+            led.attribute("data_wait", 0.2)  # between steps
+            vc.advance(0.2)
+            led.step_begin(1)
+            with led.phase("compute"):
+                vc.advance(1.0)
+            led.step_end()
+        snap = led.snapshot()
+        assert snap["phases"]["data_wait"] == pytest.approx(0.2)
+        assert snap["steps"] == 2
+        entry1 = snap["timeline"][1]
+        assert entry1["phases"]["data_wait"] == pytest.approx(0.2)
+        assert led.reconcile()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# bounded memory
+# ---------------------------------------------------------------------------
+
+class TestBoundedMemory:
+    def test_timeline_ring_and_window_are_bounded(self):
+        vc = VirtualClock()
+        led = _ledger(vc, timeline_cap=8, window=4)
+        with led.session():
+            for i in range(100):
+                led.step_begin(i)
+                with led.phase("compute"):
+                    vc.advance(0.01)
+                led.step_end()
+        snap = led.snapshot()
+        assert len(snap["timeline"]) <= 8
+        assert snap["steps"] == 100
+        # totals survive eviction even though the ring forgot the entries
+        assert snap["phases"]["compute"] == pytest.approx(1.0)
+        rec = led.reconcile()
+        assert rec["evicted"]  # and the audit says so honestly
+        assert len(led._window) <= 4
+
+    def test_rolling_frac_tracks_recent_entries_only(self):
+        vc = VirtualClock()
+        led = _ledger(vc, window=4)
+        with led.session():
+            # 10 all-idle steps, then 4 all-compute steps: the rolling
+            # fraction must see only the healthy tail
+            for i in range(10):
+                led.step_begin(i)
+                vc.advance(1.0)
+                led.step_end()
+            for i in range(10, 14):
+                led.step_begin(i)
+                with led.phase("compute"):
+                    vc.advance(1.0)
+                frac = led.step_end()
+        assert frac == pytest.approx(1.0)
+        snap = led.snapshot()
+        assert snap["rolling_frac"] == pytest.approx(1.0)
+        assert snap["goodput_frac"] == pytest.approx(4.0 / 14.0)
+
+    def test_snapshot_timeline_limit(self):
+        vc = VirtualClock()
+        led = _ledger(vc, timeline_cap=64)
+        with led.session():
+            for i in range(50):
+                led.step_begin(i)
+                vc.advance(0.01)
+                led.step_end()
+        assert len(led.snapshot(timeline_limit=5)["timeline"]) == 5
+
+    def test_reset(self):
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            led.attribute("compute", 1.0)
+            vc.advance(1.0)
+        led.reset()
+        snap = led.snapshot()
+        assert snap["wall_s"] == 0.0 and snap["steps"] == 0
+        assert not led.active
+
+
+# ---------------------------------------------------------------------------
+# registry emission + declarations
+# ---------------------------------------------------------------------------
+
+class TestEmission:
+    def test_badput_histograms_and_frac_gauge_emit(self):
+        vc = VirtualClock()
+        led = GoodputLedger(clock=vc.monotonic, emit=True)
+        h = REGISTRY.histogram("training.badput.checkpoint")
+        before = h.snapshot()["count"]
+        with led.session():
+            led.step_begin(0)
+            with led.phase("checkpoint"):
+                vc.advance(0.4)
+            with led.phase("compute"):
+                vc.advance(0.6)
+            led.step_end()
+        after = h.snapshot()
+        assert after["count"] == before + 1
+        assert REGISTRY.gauge("training.goodput.frac").value == \
+            pytest.approx(0.6)
+
+    def test_skew_probe(self):
+        vc = VirtualClock()
+        led = GoodputLedger(clock=vc.monotonic, emit=True)
+        before = REGISTRY.histogram("training.step.skew") \
+            .snapshot()["count"]
+        assert led.note_device_skew([0.010]) is None  # needs >= 2 legs
+        assert led.note_device_skew([0.010, 0.013, 0.011]) == \
+            pytest.approx(0.003)
+        after = REGISTRY.histogram("training.step.skew").snapshot()
+        assert after["count"] == before + 1
+
+    def test_emitted_metrics_are_declared_latency_family(self):
+        assert metrics_mod.is_declared("training.goodput.frac")
+        assert metrics_mod.is_declared("training.step.skew")
+        for p in BADPUT_PHASES:
+            assert metrics_mod.is_declared(f"training.badput.{p}")
+        assert metrics_mod.HISTOGRAM_FAMILY["training.badput"] == "latency"
+        assert metrics_mod.HISTOGRAM_FAMILY["training.step.skew"] == \
+            "latency"
+        # pinned family resolves buckets for the dynamic children too
+        b = metrics_mod.buckets_for("training.badput.guard")
+        assert b == metrics_mod.buckets_for("training.badput")
+        assert b is not None
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+# ---------------------------------------------------------------------------
+
+class TestFleetMerge:
+    def _host_snap(self, compute, idle, steps):
+        vc = VirtualClock()
+        led = _ledger(vc)
+        with led.session():
+            for i in range(steps):
+                led.step_begin(i)
+                with led.phase("compute"):
+                    vc.advance(compute / steps)
+                vc.advance(idle / steps)
+                led.step_end()
+        return led.snapshot()
+
+    def test_merge_sums_extensive_recomputes_frac(self):
+        a = self._host_snap(compute=9.0, idle=1.0, steps=4)
+        b = self._host_snap(compute=5.0, idle=5.0, steps=2)
+        m = merge_goodput_snapshots({"a:1": a, "b:2": b})
+        assert m["phases"]["compute"] == pytest.approx(14.0)
+        assert m["phases"]["idle"] == pytest.approx(6.0)
+        assert m["wall_s"] == pytest.approx(20.0)
+        assert m["steps"] == 6
+        assert m["goodput_frac"] == pytest.approx(0.7)
+        assert m["replicas"] == ["a:1", "b:2"]
+        # the straggler signal: healthy fleet frac, one low replica
+        assert m["frac_by_replica"]["a:1"] == pytest.approx(0.9)
+        assert m["frac_by_replica"]["b:2"] == pytest.approx(0.5)
+
+    def test_merge_survives_json_roundtrip_and_empty_sources(self):
+        a = json.loads(json.dumps(self._host_snap(1.0, 0.0, 1)))
+        m = merge_goodput_snapshots({"a:1": a, "b:2": {}})
+        assert m["phases"]["compute"] == pytest.approx(1.0)
+        assert m["frac_by_replica"]["b:2"] is None
+
+    def test_fleet_merge_snapshots_carries_goodput(self):
+        """The PR-15 federation path: merge_snapshots folds per-host
+        `goodput` keys via merge_goodput_snapshots."""
+        from mmlspark_tpu.core import telemetry
+
+        src = telemetry.export_snapshot(include_spans=False)
+        src = json.loads(json.dumps(src))
+        src["goodput"] = self._host_snap(compute=2.0, idle=0.0, steps=1)
+        merged = telemetry.merge_snapshots({"a:1": src, "b:2": src})
+        assert merged["goodput"]["phases"]["compute"] == pytest.approx(4.0)
+        assert merged["goodput"]["goodput_frac"] == pytest.approx(1.0)
+        assert set(merged["goodput_by_replica"]) == {"a:1", "b:2"}
+
+
+# ---------------------------------------------------------------------------
+# integration: the global ledger through export_snapshot and a real fit
+# ---------------------------------------------------------------------------
+
+class TestIntegration:
+    def test_export_snapshot_carries_global_ledger(self):
+        from mmlspark_tpu.core import telemetry
+
+        GOODPUT.reset()
+        try:
+            with GOODPUT.session():
+                GOODPUT.step_begin(0)
+                GOODPUT.attribute("compute", 0.0)
+                GOODPUT.step_end()
+            snap = telemetry.export_snapshot(include_spans=False)
+            assert "goodput" in snap
+            assert tuple(snap["goodput"]["phases"]) == PHASES
+            assert snap["goodput"]["steps"] == 1
+        finally:
+            GOODPUT.reset()
+
+    def test_fit_epochs_attributes_real_training(self):
+        """The instrumented per-step loop: a real (tiny) fit_epochs run
+        must land compute time in the ledger with ~full coverage."""
+        import flax.linen as nn
+        import numpy as np
+        import optax
+
+        from mmlspark_tpu.models.training import (fit_epochs,
+                                                  init_train_state,
+                                                  make_train_step)
+        from mmlspark_tpu.parallel.mesh import default_mesh
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                x = x.reshape((x.shape[0], -1))
+                return nn.Dense(4)(x), {}
+
+        GOODPUT.reset()
+        try:
+            mesh = default_mesh()
+            model, opt = M(), optax.sgd(0.1)
+            gen = np.random.default_rng(0)
+            imgs = gen.normal(size=(32, 4, 4, 1)).astype(np.float32)
+            lbls = gen.integers(0, 4, size=32).astype(np.int32)
+            step = make_train_step(model, opt, 4, mesh=mesh, donate=False)
+            state = init_train_state(model, opt, (4, 4, 1), seed=0)
+            fit_epochs(step, state, imgs, lbls, batch_size=16, epochs=1,
+                       mesh=mesh)
+            snap = GOODPUT.snapshot()
+            assert snap["steps"] >= 2
+            assert snap["phases"]["compute"] > 0.0
+            assert snap["coverage"] == pytest.approx(1.0, abs=0.05)
+            assert not GOODPUT.active  # session closed by the loop
+            assert GOODPUT.reconcile()["ok"]
+        finally:
+            GOODPUT.reset()

@@ -1,0 +1,408 @@
+"""Goodput waterfall + MFU-gap explanation: where the roofline goes.
+
+The bridge between two numbers the repo already had but could not join:
+the measured training MFU (bench.py `lm_train_mfu`, 0.227 on the last
+chip run) and the analytic ceiling (tools/roofline.py `mfu_ceiling`,
+0.45 for the LM train config).  The GoodputLedger
+(core/telemetry/goodput.py) attributes every second of training
+wall-clock to a phase; this tool renders that waterfall and charges
+each badput phase its share of the MFU gap:
+
+    0.227 measured vs 0.45 ceiling: X% data_wait, Y% recompile,
+    Z% non-matmul compute
+
+Usage:
+
+    python tools/goodput_report.py --probe lm          # live train probe
+    python tools/goodput_report.py --probe both --json
+    python tools/goodput_report.py SNAPSHOT.json       # saved snapshot
+    python tools/ci.py goodput-smoke                   # CI assertion
+
+`--probe` runs a short real training loop (tiny LM through the
+DeviceFeed + scanned epoch; tiny vision model through fit_epochs) on
+the current backend and reports the measured waterfall — on the CPU
+mesh this is the plumbing check CI runs (`--smoke` asserts phases tile
+≥95% of wall and a goodput fraction is reported); on a chip it is the
+real attribution.  With a saved `export_snapshot()` file (bench.py
+--obs-out, train_soak --obs-out, or a /metrics-adjacent dump) it
+renders the snapshot's `goodput` key instead.  The measured MFU for
+the gap table comes from --measured-mfu, else the snapshot/record,
+else BENCH_LASTGOOD.json.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LASTGOOD = os.path.join(ROOT, "BENCH_LASTGOOD.json")
+
+# phases charged to the gap as badput; "idle" folds in as host overhead
+_GAP_PHASES = ("data_wait", "h2d", "sync", "checkpoint", "recompile",
+               "guard", "idle")
+
+
+def phase_delta(gp0: Dict[str, Any], gp1: Dict[str, Any]
+                ) -> Tuple[Dict[str, float], float]:
+    """(per-phase seconds, wall seconds) accrued between two ledger
+    snapshots."""
+    p0 = gp0.get("phases") or {}
+    p1 = gp1.get("phases") or {}
+    phases = {p: float(p1.get(p, 0.0)) - float(p0.get(p, 0.0))
+              for p in set(p0) | set(p1)}
+    wall = float(gp1.get("wall_s") or 0.0) - float(gp0.get("wall_s") or 0.0)
+    return phases, wall
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def render_waterfall(phases: Dict[str, float], wall: float,
+                     title: str = "goodput") -> str:
+    """Phase waterfall table: seconds and share of measured wall-clock,
+    largest first, with the attribution-coverage footer the smoke gate
+    asserts on."""
+    total = sum(max(0.0, s) for s in phases.values())
+    denom = wall if wall > 0 else (total or 1.0)
+    lines = [f"{title}: phase waterfall over {denom:.3f}s wall"]
+    rows = [("phase", "seconds", "wall%")]
+    for p, s in sorted(phases.items(), key=lambda kv: -kv[1]):
+        if s <= 0.0:
+            continue
+        rows.append((p, f"{s:.4f}", f"{100.0 * s / denom:.1f}%"))
+    widths = [max(len(r[c]) for r in rows) for c in range(3)]
+    for i, r in enumerate(rows):
+        lines.append("  " + "  ".join(c.rjust(w) if j else c.ljust(w)
+                                      for j, (c, w) in
+                                      enumerate(zip(r, widths))).rstrip())
+        if i == 0:
+            lines.append("  " + "  ".join("-" * w for w in widths))
+    compute = max(0.0, phases.get("compute", 0.0))
+    lines.append(f"  goodput_frac={compute / denom:.3f}  "
+                 f"coverage={min(total, denom) / denom:.1%}  "
+                 f"(phases sum {total:.3f}s / wall {denom:.3f}s)")
+    return "\n".join(lines)
+
+
+def mfu_gap_rows(phases: Dict[str, float], wall: float,
+                 measured_mfu: Optional[float], ceiling: float
+                 ) -> List[Dict[str, Any]]:
+    """Charge the MFU gap to phases.  Model: with zero badput the job
+    would run at `ceiling`; a phase occupying fraction f of wall costs
+    ceiling*f MFU points.  Whatever gap the waterfall cannot explain is
+    non-matmul/kernel inefficiency INSIDE the compute phase — the
+    residual the roofline can't see from host-side timing."""
+    denom = wall if wall > 0 else (sum(phases.values()) or 1.0)
+    gap = (ceiling - measured_mfu) if measured_mfu is not None else None
+    rows: List[Dict[str, Any]] = []
+    explained = 0.0
+    for p in _GAP_PHASES:
+        s = max(0.0, phases.get(p, 0.0))
+        if s <= 0.0:
+            continue
+        frac = s / denom
+        points = ceiling * frac
+        explained += points
+        rows.append({"cause": p, "wall_frac": round(frac, 4),
+                     "mfu_points": round(points, 4),
+                     "gap_share": (round(points / gap, 4)
+                                   if gap and gap > 0 else None)})
+    if gap is not None:
+        resid = max(0.0, gap - explained)
+        rows.append({"cause": "non-matmul compute / kernel inefficiency",
+                     "wall_frac": None,
+                     "mfu_points": round(resid, 4),
+                     "gap_share": (round(resid / gap, 4)
+                                   if gap > 0 else None)})
+    return rows
+
+
+def render_mfu_table(model: str, measured_mfu: Optional[float],
+                     ceiling: float, rows: List[Dict[str, Any]]) -> str:
+    if measured_mfu is not None:
+        head = (f"mfu_explain[{model}]: {measured_mfu:.3f} measured vs "
+                f"{ceiling:.3f} ceiling "
+                f"(gap {max(0.0, ceiling - measured_mfu):.3f})")
+    else:
+        head = (f"mfu_explain[{model}]: no measured MFU "
+                f"(--measured-mfu / BENCH_LASTGOOD) — charging phases "
+                f"against the {ceiling:.3f} ceiling only")
+    out = [head]
+    tab = [("cause", "wall%", "mfu points", "gap share")]
+    for r in rows:
+        tab.append((
+            str(r["cause"]),
+            "-" if r["wall_frac"] is None else f"{100 * r['wall_frac']:.1f}%",
+            f"{r['mfu_points']:.3f}",
+            "-" if r["gap_share"] is None else f"{100 * r['gap_share']:.0f}%",
+        ))
+    widths = [max(len(row[c]) for row in tab) for c in range(4)]
+    for i, row in enumerate(tab):
+        out.append("  " + "  ".join(
+            c.ljust(w) if j == 0 else c.rjust(w)
+            for j, (c, w) in enumerate(zip(row, widths))).rstrip())
+        if i == 0:
+            out.append("  " + "  ".join("-" * w for w in widths))
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# live probes: short REAL train runs through the instrumented seams
+# ---------------------------------------------------------------------------
+
+def run_lm_probe(steps: int = 6, batch: int = 8, seq: int = 64,
+                 vocab: int = 256, embed: int = 64, layers: int = 2,
+                 heads: int = 2) -> Dict[str, Any]:
+    """Tiny-LM train run on the current backend: host token slices ride
+    the DeviceFeed (data_wait + h2d attribution), the scanned epoch is
+    the compute phase — the same seams the full loops use."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.core.telemetry import GOODPUT
+    from mmlspark_tpu.io.feed import DeviceFeed
+    from mmlspark_tpu.models.training import make_lm_train_epoch
+    from mmlspark_tpu.models.transformer import transformer_lm
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    if batch % default_mesh().shape["data"]:
+        batch = default_mesh().shape["data"]
+    mesh = default_mesh()
+    tok_sh = NamedSharding(mesh, P(None, "data"))
+    model = transformer_lm(vocab_size=vocab, embed_dim=embed,
+                           num_layers=layers, num_heads=heads,
+                           max_len=seq)
+    rng = jax.random.PRNGKey(0)
+    toks = np.random.default_rng(0).integers(
+        0, vocab, size=(steps, 1, batch, seq), dtype=np.int32)
+    params = jax.jit(lambda r, t: model.init(r, t)["params"])(
+        rng, toks[0, 0])
+    opt = optax.adam(3e-4)
+    opt_state = jax.jit(opt.init)(params)
+    epoch = make_lm_train_epoch(model, opt, mesh=mesh, donate=False)
+    # compile OUTSIDE the session: warmup compile is not steady-state
+    # recompile badput
+    params, opt_state, losses = epoch(params, opt_state,
+                                      jax.device_put(toks[0], tok_sh))
+    jax.block_until_ready(losses)
+
+    feed = DeviceFeed(mesh=mesh)
+    gp0 = GOODPUT.snapshot()
+    t0 = time.perf_counter()
+    with GOODPUT.session():
+        for i, (dt_toks,) in enumerate(
+                feed.stream(((t,) for t in toks),
+                            shardings=(tok_sh,))):
+            GOODPUT.step_begin(i)
+            with GOODPUT.phase("compute"):
+                params, opt_state, losses = epoch(params, opt_state,
+                                                  dt_toks)
+                jax.block_until_ready(losses)
+            GOODPUT.step_end()
+    measured_wall = time.perf_counter() - t0
+    phases, wall = phase_delta(gp0, GOODPUT.snapshot())
+    return {"model": "lm_train", "phases": phases, "wall_s": wall,
+            "measured_wall_s": measured_wall, "steps": steps,
+            "final_loss": float(np.asarray(losses)[-1])}
+
+
+def run_vision_probe(rows: int = 64, batch: int = 16,
+                     epochs: int = 1) -> Dict[str, Any]:
+    """Tiny vision train run through fit_epochs — the per-step path's
+    own instrumentation (session, data_wait, h2d, compute) does the
+    attribution; the probe only reads the ledger delta."""
+    import flax.linen as nn
+    import numpy as np
+    import optax
+
+    from mmlspark_tpu.core.telemetry import GOODPUT
+    from mmlspark_tpu.models.training import (fit_epochs, init_train_state,
+                                              make_train_step)
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(4)(x), {}
+
+    mesh = default_mesh()
+    model, opt = M(), optax.sgd(0.1)
+    gen = np.random.default_rng(0)
+    imgs = gen.normal(size=(rows, 8, 8, 1)).astype(np.float32)
+    lbls = gen.integers(0, 4, size=rows).astype(np.int32)
+    step = make_train_step(model, opt, 4, mesh=mesh, donate=False)
+    state = init_train_state(model, opt, (8, 8, 1), seed=0)
+    gp0 = GOODPUT.snapshot()
+    t0 = time.perf_counter()
+    state, metrics = fit_epochs(step, state, imgs, lbls, batch_size=batch,
+                                epochs=epochs, mesh=mesh)
+    measured_wall = time.perf_counter() - t0
+    phases, wall = phase_delta(gp0, GOODPUT.snapshot())
+    return {"model": "vit_base", "phases": phases, "wall_s": wall,
+            "measured_wall_s": measured_wall,
+            "steps": epochs * (rows // batch),
+            "final_loss": float(metrics.get("loss", float("nan")))}
+
+
+# ---------------------------------------------------------------------------
+# ceilings + measured MFU lookup
+# ---------------------------------------------------------------------------
+
+def roofline_ceiling(model: str, peak_tflops: float,
+                     hbm_gbs: float) -> float:
+    from tools import roofline
+
+    peak, bw = peak_tflops * 1e12, hbm_gbs * 1e9
+    if model == "lm_train":
+        _rows, summary = roofline.analyze_lm_train(16, peak, bw)
+    elif model == "vit_base":
+        _rows, summary = roofline.analyze_vit(128, peak, bw)
+    else:
+        _rows, summary = roofline.analyze(256, peak, bw)
+    return float(summary["mfu_ceiling"])
+
+
+_MEASURED_KEY = {"lm_train": "lm_train_mfu", "vit_base": "vit_mfu",
+                 "resnet50": "mfu"}
+
+
+def measured_mfu_for(model: str, record: Optional[Dict[str, Any]]
+                     ) -> Optional[float]:
+    """The model's measured MFU from a bench record, falling back to
+    BENCH_LASTGOOD.json (the last real-chip measurement)."""
+    key = _MEASURED_KEY.get(model)
+    if key is None:
+        return None
+    for src in (record or {}), _lastgood():
+        v = src.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+    return None
+
+
+def _lastgood() -> Dict[str, Any]:
+    try:
+        with open(LASTGOOD, encoding="utf-8") as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def _report(model: str, phases: Dict[str, float], wall: float,
+            measured: Optional[float], ceiling: float,
+            as_json: bool) -> Tuple[str, Dict[str, Any]]:
+    rows = mfu_gap_rows(phases, wall, measured, ceiling)
+    text = "\n".join([
+        render_waterfall(phases, wall, title=f"goodput[{model}]"),
+        render_mfu_table(model, measured, ceiling, rows),
+    ])
+    total = sum(max(0.0, s) for s in phases.values())
+    doc = {"model": model, "phases": {p: round(s, 6)
+                                      for p, s in phases.items() if s > 0},
+           "wall_s": round(wall, 6),
+           "coverage": round(min(total, wall) / wall, 6) if wall > 0 else None,
+           "goodput_frac": (round(max(0.0, phases.get("compute", 0.0))
+                                  / wall, 6) if wall > 0 else None),
+           "measured_mfu": measured, "mfu_ceiling": ceiling,
+           "gap_attribution": rows}
+    return text, doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="saved export_snapshot() JSON with a `goodput` "
+                         "key (bench/train_soak --obs-out)")
+    ap.add_argument("--probe", choices=["lm", "vision", "both"],
+                    default=None,
+                    help="run a short live train probe instead of "
+                         "reading a snapshot")
+    ap.add_argument("--steps", type=int, default=6,
+                    help="probe steps (lm probe)")
+    ap.add_argument("--measured-mfu", type=float, default=None,
+                    help="measured MFU to diff against the ceiling "
+                         "(default: bench record / BENCH_LASTGOOD)")
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--hbm-gbs", type=float, default=819.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert goodput_frac is reported and phases "
+                         "sum to >=95%% of wall (CI gate; rc 1 on fail)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.probe is None and args.snapshot is None:
+        args.probe = "lm"
+
+    runs: List[Tuple[str, Dict[str, float], float]] = []
+    record: Optional[Dict[str, Any]] = None
+    if args.snapshot:
+        with open(args.snapshot, encoding="utf-8") as f:
+            doc = json.load(f)
+        record = doc.get("record") if isinstance(doc.get("record"),
+                                                 dict) else None
+        gp = doc.get("goodput") or (doc.get("obs") or {}).get("goodput")
+        if not gp:
+            print(f"goodput-report: {args.snapshot} carries no `goodput` "
+                  f"key — run a training session (or bench --obs-out) "
+                  f"with the PR-16 ledger first", file=sys.stderr)
+            return 2
+        phases = {p: float(s) for p, s in (gp.get("phases") or {}).items()}
+        runs.append(("lm_train", phases, float(gp.get("wall_s") or 0.0)))
+    if args.probe in ("lm", "both"):
+        r = run_lm_probe(steps=args.steps)
+        runs.append((r["model"], r["phases"], r["wall_s"]))
+    if args.probe in ("vision", "both"):
+        r = run_vision_probe()
+        runs.append((r["model"], r["phases"], r["wall_s"]))
+
+    rc = 0
+    docs = []
+    for model, phases, wall in runs:
+        measured = (args.measured_mfu if args.measured_mfu is not None
+                    else measured_mfu_for(model, record))
+        ceiling = roofline_ceiling(model, args.peak_tflops, args.hbm_gbs)
+        text, doc = _report(model, phases, wall, measured, ceiling,
+                            args.json)
+        docs.append(doc)
+        if not args.json:
+            print(text)
+            print()
+        if args.smoke:
+            cov = doc["coverage"]
+            if doc["goodput_frac"] is None:
+                print(f"goodput-smoke: FAIL[{model}] — no goodput_frac "
+                      f"reported (wall {wall:.3f}s)", file=sys.stderr)
+                rc = 1
+            elif cov is None or cov < 0.95:
+                print(f"goodput-smoke: FAIL[{model}] — phases cover "
+                      f"{cov if cov is not None else 0:.1%} of wall "
+                      f"(< 95%)", file=sys.stderr)
+                rc = 1
+            else:
+                print(f"goodput-smoke: OK[{model}] — goodput_frac="
+                      f"{doc['goodput_frac']:.3f}, coverage={cov:.1%}")
+    if args.json:
+        print(json.dumps(docs if len(docs) > 1 else docs[0], indent=2,
+                         sort_keys=True))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
